@@ -1,0 +1,35 @@
+"""byteps_tpu — a TPU-native gradient-synchronization framework.
+
+A brand-new, TPU-first implementation of the capability set of BytePS
+(reference: ymjiang/byteps — see SURVEY.md): hierarchical two-level gradient
+aggregation (intra-slice ICI collectives via XLA/shard_map + an inter-host
+DCN key-value push/pull leg to CPU-only parameter servers), tensor
+partitioning, priority-credit scheduling, pluggable gradient compression,
+sync and async training modes, a Horovod-style user API, and a multi-role
+launcher.
+
+Layout (capability parity with the reference's layer map, SURVEY.md §1):
+
+- ``byteps_tpu.config``     — env-var config system (docs/env.md parity).
+- ``byteps_tpu.topology``   — roles, ranks, mesh construction.
+- ``byteps_tpu.partition``  — tensor → partition slicing + key assignment.
+- ``byteps_tpu.core``       — C++ runtime (DCN van, PS server, CPU reducer,
+                              priority scheduler) + ctypes bindings.
+- ``byteps_tpu.jax``        — the JAX framework plugin (init/push_pull/
+                              DistributedOptimizer/broadcast_parameters);
+                              the equivalent of the reference's byteps/torch.
+- ``byteps_tpu.parallel``   — mesh/sharding utilities: hierarchical DP,
+                              ring-attention sequence parallelism, TP/PP/EP.
+- ``byteps_tpu.ops``        — Pallas TPU kernels for hot ops.
+- ``byteps_tpu.compression``— gradient compression plugin registry
+                              (onebit/topk/randomk/dithering + error
+                              feedback + momentum), JAX-native codecs.
+- ``byteps_tpu.models``     — flax model zoo used by examples/benchmarks.
+- ``byteps_tpu.server``     — ``import byteps_tpu.server`` runs a CPU PS
+                              (reference: byteps/server/__init__.py).
+- ``byteps_tpu.launcher``   — ``bpslaunch``-style multi-role launcher.
+"""
+
+__version__ = "0.1.0"
+
+from byteps_tpu.config import Config, get_config  # noqa: F401

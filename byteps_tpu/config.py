@@ -1,0 +1,171 @@
+"""Environment-variable configuration system.
+
+Capability parity with the reference's config surface (SURVEY.md §5
+"Config / flag system"): the reference is configured *entirely* through
+environment variables, documented in its ``docs/env.md``. We keep the same
+names for the ``DMLC_*`` (role / addressing, inherited from ps-lite) and
+``BYTEPS_*`` (core tuning) families so operators can switch without
+relearning, and add a typed, validated layer on top.
+
+Reference symbols: ps-lite ``Postoffice`` env parsing (DMLC_NUM_WORKER,
+DMLC_NUM_SERVER, DMLC_ROLE, DMLC_PS_ROOT_URI, DMLC_PS_ROOT_PORT) and
+``BytePSGlobal::Init`` env parsing (byteps/common/global.cc).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+_TRUTHY = {"1", "true", "yes", "on"}
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else default
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return default
+    return v.strip().lower() in _TRUTHY
+
+
+def _env_str(name: str, default: str) -> str:
+    v = os.environ.get(name)
+    return v if v not in (None, "") else default
+
+
+VALID_ROLES = ("worker", "server", "scheduler", "joint")
+
+
+@dataclasses.dataclass
+class Config:
+    """Typed snapshot of the byteps_tpu environment configuration."""
+
+    # --- DMLC_* family: process roles and scheduler addressing -------------
+    role: str = "worker"                  # DMLC_ROLE
+    num_worker: int = 1                   # DMLC_NUM_WORKER
+    num_server: int = 0                   # DMLC_NUM_SERVER
+    root_uri: str = "127.0.0.1"           # DMLC_PS_ROOT_URI (scheduler host)
+    root_port: int = 9000                 # DMLC_PS_ROOT_PORT
+    worker_id: int = 0                    # DMLC_WORKER_ID (host index)
+
+    # --- BYTEPS_* family: core tuning --------------------------------------
+    partition_bytes: int = 4096000        # BYTEPS_PARTITION_BYTES (~4 MB)
+    scheduling_credit: int = 4            # BYTEPS_SCHEDULING_CREDIT
+    #   credit unit = in-flight partitions admitted to the DCN push stage
+    local_rank: int = 0                   # BYTEPS_LOCAL_RANK
+    local_size: int = 1                   # BYTEPS_LOCAL_SIZE
+    log_level: str = "WARNING"            # BYTEPS_LOG_LEVEL
+    force_distributed: bool = False       # BYTEPS_FORCE_DISTRIBUTED
+    enable_async: bool = False            # BYTEPS_ENABLE_ASYNC
+    server_engine_threads: int = 4        # BYTEPS_SERVER_ENGINE_THREAD
+    compressor: str = ""                  # BYTEPS_COMPRESSOR (default for all
+    #   tensors; per-tensor override via declare_tensor(compression=...))
+    compressor_k: int = 0                 # BYTEPS_COMPRESSOR_K
+    error_feedback: str = ""              # BYTEPS_ERROR_FEEDBACK ("vanilla")
+    momentum: str = ""                    # BYTEPS_MOMENTUM ("nesterov")
+    momentum_mu: float = 0.9              # BYTEPS_MOMENTUM_MU
+
+    # --- tracing (reference: BYTEPS_TRACE_*, SURVEY.md §5) -----------------
+    trace_on: bool = False                # BYTEPS_TRACE_ON
+    trace_dir: str = "./traces"           # BYTEPS_TRACE_DIR
+    trace_start_step: int = 1             # BYTEPS_TRACE_START_STEP
+    trace_end_step: int = 10              # BYTEPS_TRACE_END_STEP
+
+    # --- TPU-specific (new scope; no reference equivalent) -----------------
+    ici_axis: str = "ici"                 # mesh axis name for intra-slice
+    dcn_axis: str = "dcn"                 # mesh axis name for inter-slice
+    ps_mode: str = "auto"                 # BYTEPS_PS_MODE: auto|collective|ps
+    #   collective: both levels via XLA collectives (single-controller SPMD)
+    #   ps:         DCN level via C++ KV push/pull to CPU parameter servers
+    #   auto:       ps iff a scheduler is configured (num_server > 0 or
+    #               force_distributed), else collective
+    heartbeat_interval_s: float = 5.0     # PS_HEARTBEAT_INTERVAL
+    heartbeat_timeout_s: float = 30.0     # PS_HEARTBEAT_TIMEOUT
+
+    @property
+    def size(self) -> int:
+        return self.num_worker * self.local_size
+
+    @property
+    def distributed(self) -> bool:
+        """True when the DCN/PS leg is active (reference: BytePSGlobal's
+        _is_distributed_job: num_server > 0 or BYTEPS_FORCE_DISTRIBUTED)."""
+        return self.num_server > 0 or self.force_distributed
+
+    @property
+    def use_ps(self) -> bool:
+        if self.ps_mode == "ps":
+            return True
+        if self.ps_mode == "collective":
+            return False
+        return self.distributed
+
+    def validate(self) -> "Config":
+        if self.role not in VALID_ROLES:
+            raise ValueError(
+                f"DMLC_ROLE must be one of {VALID_ROLES}, got {self.role!r}")
+        if self.partition_bytes <= 0:
+            raise ValueError("BYTEPS_PARTITION_BYTES must be positive")
+        if self.scheduling_credit <= 0:
+            raise ValueError("BYTEPS_SCHEDULING_CREDIT must be positive")
+        if self.num_worker < 1:
+            raise ValueError("DMLC_NUM_WORKER must be >= 1")
+        if self.ps_mode not in ("auto", "collective", "ps"):
+            raise ValueError("BYTEPS_PS_MODE must be auto|collective|ps")
+        return self
+
+
+def load_config() -> Config:
+    """Read the full configuration from the environment (one snapshot)."""
+    return Config(
+        role=_env_str("DMLC_ROLE", "worker").lower(),
+        num_worker=_env_int("DMLC_NUM_WORKER", 1),
+        num_server=_env_int("DMLC_NUM_SERVER", 0),
+        root_uri=_env_str("DMLC_PS_ROOT_URI", "127.0.0.1"),
+        root_port=_env_int("DMLC_PS_ROOT_PORT", 9000),
+        worker_id=_env_int("DMLC_WORKER_ID", 0),
+        partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", 4096000),
+        scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 4),
+        local_rank=_env_int("BYTEPS_LOCAL_RANK", 0),
+        local_size=_env_int("BYTEPS_LOCAL_SIZE", 1),
+        log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING").upper(),
+        force_distributed=_env_bool("BYTEPS_FORCE_DISTRIBUTED"),
+        enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
+        server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", 4),
+        compressor=_env_str("BYTEPS_COMPRESSOR", ""),
+        compressor_k=_env_int("BYTEPS_COMPRESSOR_K", 0),
+        error_feedback=_env_str("BYTEPS_ERROR_FEEDBACK", ""),
+        momentum=_env_str("BYTEPS_MOMENTUM", ""),
+        momentum_mu=float(os.environ.get("BYTEPS_MOMENTUM_MU", "0.9")),
+        trace_on=_env_bool("BYTEPS_TRACE_ON"),
+        trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
+        trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 1),
+        trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 10),
+        ici_axis=_env_str("BYTEPS_ICI_AXIS", "ici"),
+        dcn_axis=_env_str("BYTEPS_DCN_AXIS", "dcn"),
+        ps_mode=_env_str("BYTEPS_PS_MODE", "auto").lower(),
+        heartbeat_interval_s=float(os.environ.get("PS_HEARTBEAT_INTERVAL", "5")),
+        heartbeat_timeout_s=float(os.environ.get("PS_HEARTBEAT_TIMEOUT", "30")),
+    ).validate()
+
+
+_config: Optional[Config] = None
+
+
+def get_config(reload: bool = False) -> Config:
+    """Return the process-wide Config, loading from env on first use."""
+    global _config
+    if _config is None or reload:
+        _config = load_config()
+    return _config
+
+
+def set_config(cfg: Config) -> None:
+    """Install an explicit Config (used by tests and the launcher)."""
+    global _config
+    _config = cfg.validate()

@@ -1,0 +1,325 @@
+"""byteps_tpu.jax — the JAX framework plugin (the adapter boundary).
+
+Capability parity with the reference's framework plugins (SURVEY.md §2.5,
+byteps/torch/__init__.py + ops.py): ``init``, ``rank/size/local_rank/
+local_size``, ``push_pull`` (+ ``_async``/``poll``/``synchronize``),
+``declare_tensor``, ``DistributedOptimizer``, ``broadcast_parameters``.
+
+TPU-first semantics:
+
+- ``push_pull`` is *per-device* code when called inside ``jax.shard_map``
+  (the hot path — XLA fuses the hierarchical ICI reduce-scatter/all-gather
+  into the step program), and auto-wraps itself in a jitted shard_map when
+  called on stacked per-replica arrays outside jit.
+- Async handles map onto JAX's asynchronous dispatch: ``push_pull_async``
+  returns immediately with arrays whose computation is in flight;
+  ``synchronize`` blocks on them (reference: HandleManager + poll/
+  synchronize, byteps/torch/handle_manager.cc — on TPU the runtime already
+  gives us the async handle table for free).
+- ``DistributedOptimizer`` is an optax gradient-transformation wrapper: the
+  idiomatic JAX counterpart of wrapping ``optimizer.step()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from byteps_tpu.config import Config, get_config
+from byteps_tpu.jax.compression import Compression, Compressor
+from byteps_tpu.parallel import hierarchical as _h
+from byteps_tpu.parallel.mesh import build_mesh, set_global_mesh
+from byteps_tpu.partition import TensorRegistry
+
+from byteps_tpu.jax._compat import shard_map as _shard_map
+
+__all__ = [
+    "init", "shutdown", "initialized", "rank", "size", "device_count",
+    "local_rank", "local_size", "push_pull", "push_pull_async", "poll", "synchronize",
+    "declare_tensor", "broadcast_parameters", "DistributedOptimizer",
+    "Compression", "mesh",
+]
+
+
+@dataclasses.dataclass
+class _State:
+    config: Config
+    mesh: Mesh
+    registry: TensorRegistry
+    ps_client: Any = None  # C++ KV client (PS mode), wired in core.ffi
+
+
+_state: Optional[_State] = None
+_lock = threading.Lock()
+
+
+def init(mesh: Optional[Mesh] = None, config: Optional[Config] = None) -> None:
+    """Initialise byteps_tpu (reference: bps.init() → byteps_init,
+    SURVEY.md §3.2). Builds/installs the (dcn, ici) device mesh, the tensor
+    registry, and — in PS mode — the C++ KV client connection to the
+    scheduler."""
+    global _state
+    with _lock:
+        cfg = config or get_config(reload=True)
+        if mesh is None:
+            mesh = build_mesh(dcn_axis=cfg.dcn_axis, ici_axis=cfg.ici_axis)
+        set_global_mesh(mesh)
+        registry = TensorRegistry(cfg.partition_bytes,
+                                  max(1, cfg.num_server))
+        ps_client = None
+        if cfg.use_ps:
+            try:
+                from byteps_tpu.core import ffi as _ffi
+            except ImportError as e:
+                raise RuntimeError(
+                    "PS mode requested (BYTEPS_PS_MODE=ps / DMLC_NUM_SERVER>0"
+                    " / BYTEPS_FORCE_DISTRIBUTED=1) but the byteps_tpu C++ "
+                    "core is not built. Build it with "
+                    "`python -m byteps_tpu.core.build`, or set "
+                    "BYTEPS_PS_MODE=collective to use pure XLA collectives."
+                ) from e
+            ps_client = _ffi.Worker.start(cfg)
+        _state = _State(cfg, mesh, registry, ps_client)
+
+
+def shutdown() -> None:
+    """Tear down (reference: byteps_shutdown)."""
+    global _state
+    with _lock:
+        if _state is not None and _state.ps_client is not None:
+            _state.ps_client.shutdown()
+        _state = None
+
+
+def initialized() -> bool:
+    return _state is not None
+
+
+def _st() -> _State:
+    if _state is None:
+        raise RuntimeError("byteps_tpu.jax.init() has not been called")
+    return _state
+
+
+def mesh() -> Mesh:
+    return _st().mesh
+
+
+# --- topology queries (reference: BytePSBasics, byteps/common/__init__.py) --
+#
+# Horovod-contract note: in the reference, one process drives one GPU, so
+# rank/size are simultaneously the process index and the chip index. Under
+# single-controller JAX one process drives all its local chips, so the two
+# notions split. We keep the Horovod invariant rank() ∈ [0, size()) at the
+# *process* level — the level at which users shard input data — and expose
+# the chip count separately as device_count() (the gradient-averaging
+# denominator, applied internally by push_pull).
+
+def rank() -> int:
+    """Index of this controller process in [0, size())."""
+    _st()
+    return jax.process_index()
+
+
+def size() -> int:
+    """Number of controller processes (use with rank() for data sharding)."""
+    _st()
+    return jax.process_count()
+
+
+def device_count() -> int:
+    """Total participating chips — the reduction denominator."""
+    return _st().mesh.size
+
+
+def local_rank() -> int:
+    """This process's index among processes on the same host."""
+    return _st().config.local_rank
+
+
+def local_size() -> int:
+    """Number of chips driven by this process."""
+    _st()
+    return jax.local_device_count()
+
+
+# --- push_pull -------------------------------------------------------------
+
+def _axes():
+    st = _st()
+    names = st.mesh.axis_names
+    ici = st.config.ici_axis if st.config.ici_axis in names else None
+    dcn = st.config.dcn_axis if st.config.dcn_axis in names else None
+    return ici, dcn
+
+
+def _dcn_reduce_fn():
+    """The slow-level reduction hook: None → XLA DCN psum (collective
+    mode); PS mode routes through the C++ KV client (core.ffi)."""
+    st = _st()
+    if st.ps_client is None:
+        return None
+    from byteps_tpu.core import ffi as _ffi
+    return _ffi.make_dcn_reduce_fn(st.ps_client, st.registry)
+
+
+def _inside_spmd(axis: Optional[str]) -> bool:
+    if axis is None:
+        return False
+    try:
+        lax.axis_size(axis)
+        return True
+    except Exception:  # unbound axis name outside shard_map
+        return False
+
+
+def push_pull(tree, average: bool = True, name: Optional[str] = None,
+              compression: Compressor = Compression.none):
+    """Sum (or average) a pytree of gradients across all chips.
+
+    Inside ``shard_map`` this is the hot path: hierarchical two-level
+    all-reduce (SURVEY.md §3.3's REDUCE→PUSH/PULL→BROADCAST pipeline as one
+    fused XLA program). Outside, arrays must carry a leading replica axis of
+    length ``size()`` (stacked per-replica values) and the same collective
+    runs under a jitted shard_map.
+    """
+    ici, dcn = _axes()
+    if _inside_spmd(ici) or _inside_spmd(dcn):
+        return _per_device_push_pull(tree, average, compression)
+    return _global_push_pull(tree, average, compression)
+
+
+def _per_device_push_pull(tree, average, compression):
+    ici, dcn = _axes()
+    orig_dtypes = jax.tree_util.tree_map(lambda x: x.dtype, tree)
+    tree = jax.tree_util.tree_map(compression.compress, tree)
+    red = _h.tree_all_reduce(
+        tree, ici_axis=ici, dcn_axis=dcn, average=average,
+        dcn_reduce_fn=_dcn_reduce_fn())
+    return jax.tree_util.tree_map(
+        lambda x, d: compression.decompress(x, d), red, orig_dtypes)
+
+
+def _global_push_pull(tree, average, compression):
+    st = _st()
+    n = st.mesh.size
+    ici, dcn = _axes()
+    mesh_axes = tuple(a for a in (dcn, ici) if a)
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return tree
+    for leaf in leaves:
+        if leaf.ndim == 0 or leaf.shape[0] != n:
+            raise ValueError(
+                "push_pull outside shard_map expects arrays stacked over a "
+                f"leading replica axis of length size()={n}; got shape "
+                f"{leaf.shape}. Inside a shard_map'd step, call push_pull "
+                "on the per-device gradients directly.")
+
+    @partial(jax.jit)
+    @partial(_shard_map, mesh=st.mesh, in_specs=P(mesh_axes),
+             out_specs=P(), check_vma=False)
+    def _run(stacked):
+        local = jax.tree_util.tree_map(lambda x: x[0], stacked)
+        return _per_device_push_pull(local, average, compression)
+
+    return _run(tree)
+
+
+# --- async handle surface (reference: handle_manager.cc + ops.py) ----------
+
+@dataclasses.dataclass
+class Handle:
+    """An in-flight push_pull (JAX async dispatch is the handle table)."""
+
+    value: Any
+
+
+def push_pull_async(tree, average: bool = True, name: Optional[str] = None,
+                    compression: Compressor = Compression.none) -> Handle:
+    return Handle(push_pull(tree, average=average, name=name,
+                            compression=compression))
+
+
+def poll(handle: Handle) -> bool:
+    """True iff the result is materialised (reference: byteps_torch_poll)."""
+    leaves = jax.tree_util.tree_leaves(handle.value)
+    return all(l.is_ready() for l in leaves if hasattr(l, "is_ready"))
+
+
+def synchronize(handle: Handle):
+    """Block until the result is ready and return it."""
+    return jax.block_until_ready(handle.value)
+
+
+# --- declare / broadcast ----------------------------------------------------
+
+def declare_tensor(name: str, shape, dtype) -> None:
+    """Pre-register a tensor (reference: byteps_declare_tensor). Establishes
+    declaration-order priority and the partition/key table used by the PS
+    path and the trace timeline."""
+    _st().registry.declare(name, tuple(shape), jnp.dtype(dtype).name)
+
+
+def broadcast_parameters(tree, root_rank: int = 0):
+    """Replicate ``tree`` from ``root_rank``'s copy to all chips (reference:
+    broadcast_parameters, SURVEY.md §3.4).
+
+    Inside shard_map: a masked-psum broadcast over both axes. Outside, with
+    single-controller JAX, parameters are already logically replicated, so
+    this devolves to installing a fully-replicated sharding — the TPU-native
+    equivalent of init-time weight sync.
+    """
+    ici, dcn = _axes()
+    if _inside_spmd(ici) or _inside_spmd(dcn):
+        return _h.tree_broadcast(tree, root=root_rank,
+                                 ici_axis=ici, dcn_axis=dcn)
+    st = _st()
+    repl = jax.sharding.NamedSharding(st.mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, repl), tree)
+
+
+# --- DistributedOptimizer ---------------------------------------------------
+
+def DistributedOptimizer(
+    optimizer: optax.GradientTransformation,
+    *,
+    average: bool = True,
+    compression: Compressor = Compression.none,
+    backward_passes_per_step: int = 1,
+) -> optax.GradientTransformation:
+    """Wrap an optax optimizer so updates are push_pull'd before applying.
+
+    Reference: byteps/torch DistributedOptimizer (SURVEY.md §2.5) — which
+    hooks autograd to overlap communication with backward compute. In JAX
+    the overlap is XLA's job: call ``update`` inside your shard_map'd jitted
+    train step and the fused reduce-scatter/all-gather is scheduled by the
+    compiler alongside remaining compute.
+
+    ``backward_passes_per_step`` > 1 reproduces the reference's gradient
+    accumulation contract: grads are accumulated locally that many times and
+    communicated once (use with ``optax.MultiSteps`` or lax.scan'd
+    microbatching; the division by the accumulation count is the caller's,
+    exactly as in the reference).
+    """
+    if backward_passes_per_step < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+
+    def init_fn(params):
+        return optimizer.init(params)
+
+    def update_fn(updates, state, params=None, **extra):
+        updates = push_pull(updates, average=average, compression=compression)
+        return optimizer.update(updates, state, params, **extra)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,30 @@
+"""JAX version compatibility shims."""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.8 exports shard_map at top level
+    from jax import shard_map as _raw_shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _raw_shard_map
+
+# The replication-check kwarg was renamed check_rep -> check_vma across jax
+# versions; detect which one this jax accepts.
+_params = inspect.signature(_raw_shard_map).parameters
+if "check_vma" in _params:
+    _CHECK_KW = "check_vma"
+elif "check_rep" in _params:  # pragma: no cover - older jax
+    _CHECK_KW = "check_rep"
+else:  # pragma: no cover
+    _CHECK_KW = None
+
+
+def shard_map(f=None, /, *, mesh, in_specs, out_specs, check_vma=True):
+    """jax.shard_map with the replication-check kwarg name normalised."""
+    kwargs = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs}
+    if _CHECK_KW is not None:
+        kwargs[_CHECK_KW] = check_vma
+    if f is None:
+        return lambda g: _raw_shard_map(g, **kwargs)
+    return _raw_shard_map(f, **kwargs)

@@ -1,0 +1,81 @@
+"""Canonical data-parallel training step builder.
+
+The reference's end-user contract (SURVEY.md §3.3): wrap your optimizer,
+call ``loss.backward()``; gradients are push_pull'd behind the scenes and
+``step()`` applies the synchronized update. The JAX-native equivalent is a
+*jitted, shard_map'd step function*: gradients come out of ``value_and_grad``
+per-device, ``push_pull`` fuses the hierarchical reduction into the same XLA
+program, and the optimizer update runs replicated. XLA overlaps the ICI
+collectives with remaining backward compute — the compiler plays the role of
+the reference's priority-scheduled background pipeline threads.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import byteps_tpu.jax as bps
+from byteps_tpu.jax.compression import Compression, Compressor
+
+from byteps_tpu.jax._compat import shard_map as _shard_map
+
+
+def make_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    *,
+    average: bool = True,
+    compression: Compressor = Compression.none,
+    donate: bool = True,
+):
+    """Build ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
+
+    ``loss_fn(params, batch) -> scalar``. ``batch`` is a pytree whose leaves
+    carry the global batch on their leading axis; it is sharded over the
+    (dcn, ici) mesh axes. Params/opt_state are replicated. The returned step
+    is jitted with donated params/opt_state (in-place buffer reuse in HBM).
+    """
+    mesh = mesh or bps.mesh()
+    cfg = bps._st().config
+    axes = tuple(a for a in (cfg.dcn_axis, cfg.ici_axis)
+                 if a in mesh.axis_names)
+
+    @partial(_shard_map, mesh=mesh,
+             in_specs=(P(), P(), P(axes)),
+             out_specs=(P(), P(), P()),
+             check_vma=False)
+    def _step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        grads = bps.push_pull(grads, average=average, compression=compression)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        for ax in axes:
+            loss = lax.pmean(loss, ax)
+        return params, opt_state, loss
+
+    jit_kwargs = {"donate_argnums": (0, 1)} if donate else {}
+    return jax.jit(_step, **jit_kwargs)
+
+
+def replicate(tree, mesh: Optional[Mesh] = None):
+    """Place a host pytree replicated on every device of the mesh."""
+    mesh = mesh or bps.mesh()
+    sharding = jax.sharding.NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def shard_batch(batch, mesh: Optional[Mesh] = None):
+    """Shard a host batch over the data-parallel mesh axes (leading dim)."""
+    mesh = mesh or bps.mesh()
+    cfg = bps._st().config
+    axes = tuple(a for a in (cfg.dcn_axis, cfg.ici_axis)
+                 if a in mesh.axis_names)
+    sharding = jax.sharding.NamedSharding(mesh, P(axes))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)

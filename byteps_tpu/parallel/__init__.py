@@ -1,0 +1,21 @@
+"""Mesh/sharding utilities: hierarchical DP, sequence/context parallelism,
+tensor parallelism, pipeline parallelism, expert parallelism.
+
+The reference (SURVEY.md §2.7) ships data parallelism (sync + async) with
+hierarchical two-level reduction. This package provides that as the core
+(``hierarchical``) and adds the TPU-first mesh-axis generalizations the
+task requires (ring attention SP, TP, PP, EP) as first-class citizens.
+"""
+
+from byteps_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    build_mesh,
+    global_mesh,
+    set_global_mesh,
+)
+from byteps_tpu.parallel.hierarchical import (  # noqa: F401
+    hierarchical_all_reduce,
+    hierarchical_broadcast,
+    tree_all_reduce,
+    tree_broadcast,
+)

@@ -1,0 +1,169 @@
+"""Hierarchical two-level all-reduce — the heart of the framework.
+
+Capability parity with the reference's core pipeline (SURVEY.md §3.3,
+byteps/common/core_loops.cc): NCCL reduce-scatter intra-node → push/pull to
+CPU parameter servers inter-node → NCCL broadcast/all-gather back. The
+TPU-native mapping:
+
+    REDUCE (NCCL reduce-scatter)  →  lax.psum_scatter over the ``ici`` axis
+    PUSH/PULL (ps-lite over TCP)  →  ``dcn_reduce_fn``: either
+                                     lax.psum over the ``dcn`` axis
+                                     (XLA DCN collective, collective mode)
+                                     or a host callback into the C++ KV
+                                     client → CPU PS (PS mode)
+    BROADCAST (NCCL all-gather)   →  lax.all_gather over the ``ici`` axis
+
+Every function here is *per-device* code: call it inside ``jax.shard_map``
+over a mesh with the named axes. Shapes are static; padding is applied so
+reduce-scatter tiles evenly — both required for XLA to schedule the
+collectives on ICI without host round-trips.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+ReduceFn = Callable[[jax.Array], jax.Array]
+
+
+def _axis_size(axis: Optional[str]) -> int:
+    return lax.axis_size(axis) if axis else 1
+
+
+def hierarchical_all_reduce(
+    x: jax.Array,
+    *,
+    ici_axis: Optional[str] = "ici",
+    dcn_axis: Optional[str] = "dcn",
+    average: bool = True,
+    dcn_reduce_fn: Optional[ReduceFn] = None,
+) -> jax.Array:
+    """Two-level all-reduce of one array (per-device code under shard_map).
+
+    Stage 1 reduce-scatters over the fast ``ici`` axis so each chip owns
+    1/ici_size of the gradient; stage 2 reduces those shards over the slow
+    ``dcn`` axis (or hands them to ``dcn_reduce_fn`` — the PS hook); stage 3
+    all-gathers the result back over ``ici``. With 1/N-sized shards on the
+    slow fabric this is bandwidth-optimal, exactly the reference's rationale
+    (docs/rationale.md) transplanted to ICI/DCN.
+    """
+    ici = ici_axis if ici_axis and _axis_size(ici_axis) > 1 else None
+    dcn = dcn_axis if dcn_axis and _axis_size(dcn_axis) > 1 else None
+    denom = _axis_size(ici) * _axis_size(dcn)
+
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+
+    if ici is None:
+        # Single-chip slice: only the slow-level reduction applies.
+        if dcn is not None:
+            flat = dcn_reduce_fn(flat) if dcn_reduce_fn else lax.psum(flat, dcn)
+        if average and denom > 1:
+            flat = flat / denom
+        return flat.reshape(orig_shape).astype(orig_dtype)
+
+    ici_size = _axis_size(ici)
+    pad = (-n) % ici_size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+
+    shard = lax.psum_scatter(flat, ici, scatter_dimension=0, tiled=True)
+    if dcn is not None:
+        shard = dcn_reduce_fn(shard) if dcn_reduce_fn else lax.psum(shard, dcn)
+    if average and denom > 1:
+        shard = shard / denom
+    out = lax.all_gather(shard, ici, axis=0, tiled=True)
+    if pad:
+        out = out[:n]
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
+def tree_all_reduce(
+    tree,
+    *,
+    ici_axis: Optional[str] = "ici",
+    dcn_axis: Optional[str] = "dcn",
+    average: bool = True,
+    dcn_reduce_fn: Optional[ReduceFn] = None,
+    fuse: bool = True,
+) -> "jax.tree_util.PyTreeDef":
+    """All-reduce a pytree of arrays (per-device code under shard_map).
+
+    With ``fuse=True`` all leaves are flattened into one contiguous bf16/f32
+    buffer first (reference analogue: tensor fusion, and the reason BytePS
+    partitions at ~4 MB — big transfers saturate the fabric; SURVEY.md §6
+    "saturates 100 Gbps with ≥4 MB partitions"). One fused reduce-scatter /
+    all-gather keeps ICI busy with a single large transfer and lets XLA
+    overlap it with whatever compute remains.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    if not fuse:
+        red = [
+            hierarchical_all_reduce(
+                g, ici_axis=ici_axis, dcn_axis=dcn_axis, average=average,
+                dcn_reduce_fn=dcn_reduce_fn)
+            for g in leaves
+        ]
+        return jax.tree_util.tree_unflatten(treedef, red)
+
+    # Fused path: one flat buffer in the widest participating dtype.
+    acc_dtype = jnp.result_type(*[l.dtype for l in leaves])
+    sizes = [l.size for l in leaves]
+    flat = jnp.concatenate([l.reshape(-1).astype(acc_dtype) for l in leaves])
+    flat = hierarchical_all_reduce(
+        flat, ici_axis=ici_axis, dcn_axis=dcn_axis, average=average,
+        dcn_reduce_fn=dcn_reduce_fn)
+    out, off = [], 0
+    for leaf, sz in zip(leaves, sizes):
+        out.append(flat[off:off + sz].reshape(leaf.shape).astype(leaf.dtype))
+        off += sz
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def hierarchical_broadcast(
+    x: jax.Array,
+    *,
+    root: int = 0,
+    ici_axis: Optional[str] = "ici",
+    dcn_axis: Optional[str] = "dcn",
+) -> jax.Array:
+    """Broadcast ``x`` from the device with linearised index ``root``.
+
+    Reference analogue: ``broadcast_parameters`` (SURVEY.md §3.4) — root's
+    values pushed, everyone pulls the same buffer. Implemented as a masked
+    psum (zero everywhere but root), which XLA lowers to an efficient
+    broadcast over ICI+DCN.
+    """
+    ici = ici_axis if ici_axis and _axis_size(ici_axis) > 1 else None
+    dcn = dcn_axis if dcn_axis and _axis_size(dcn_axis) > 1 else None
+    idx = jnp.int32(0)
+    scale = 1
+    if ici is not None:
+        idx = idx + lax.axis_index(ici)
+        scale = _axis_size(ici)
+    if dcn is not None:
+        idx = idx + lax.axis_index(dcn) * scale
+    mask = (idx == root).astype(x.dtype)
+    y = x * mask
+    if ici is not None:
+        y = lax.psum(y, ici)
+    if dcn is not None:
+        y = lax.psum(y, dcn)
+    return y
+
+
+def tree_broadcast(tree, *, root: int = 0,
+                   ici_axis: Optional[str] = "ici",
+                   dcn_axis: Optional[str] = "dcn"):
+    """Broadcast a pytree from ``root`` (per-device code under shard_map)."""
+    return jax.tree_util.tree_map(
+        lambda x: hierarchical_broadcast(
+            x, root=root, ici_axis=ici_axis, dcn_axis=dcn_axis),
+        tree)

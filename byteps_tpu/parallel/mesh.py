@@ -1,0 +1,86 @@
+"""Device-mesh construction for the two-level (ICI × DCN) topology.
+
+TPU-native replacement for the reference's topology discovery
+(byteps/common/global.cc ``BytePSGlobal::Init``: rank/local_rank/size/
+local_size + NCCL communicator setup, SURVEY.md §2.1). On TPU, the
+"local" (fast) domain is the ICI-connected slice and the "inter-host"
+(slow) domain is DCN between slices; we encode both as named mesh axes so
+XLA emits ICI collectives for the inner axis and DCN collectives for the
+outer one — the exact analogue of NCCL-then-ps-lite in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Named description of the data-parallel mesh.
+
+    ``dcn`` is the slow/outer axis (inter-slice, parameter-server leg in PS
+    mode); ``ici`` is the fast/inner axis (intra-slice reduce-scatter /
+    all-gather). Either may be 1.
+    """
+
+    dcn: int
+    ici: int
+    dcn_axis: str = "dcn"
+    ici_axis: str = "ici"
+
+    @property
+    def size(self) -> int:
+        return self.dcn * self.ici
+
+
+def build_mesh(
+    spec: Optional[MeshSpec] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    *,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+) -> Mesh:
+    """Build a 2-D (dcn, ici) mesh over the available devices.
+
+    Default layout: one dcn group per process (so the outer axis crosses
+    host/DCN boundaries exactly like the reference's inter-node PS stage),
+    all local devices on the ici axis. On a single process this collapses
+    to dcn=1 × ici=<local devices>.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if spec is None:
+        n_proc = max(1, jax.process_count())
+        if n % n_proc == 0 and n_proc > 1:
+            spec = MeshSpec(dcn=n_proc, ici=n // n_proc,
+                            dcn_axis=dcn_axis, ici_axis=ici_axis)
+        else:
+            spec = MeshSpec(dcn=1, ici=n, dcn_axis=dcn_axis, ici_axis=ici_axis)
+    if spec.size != n:
+        raise ValueError(
+            f"MeshSpec {spec.dcn}x{spec.ici} != device count {n}")
+    arr = np.asarray(devices).reshape(spec.dcn, spec.ici)
+    return Mesh(arr, (spec.dcn_axis, spec.ici_axis))
+
+
+_global_mesh: Optional[Mesh] = None
+
+
+def set_global_mesh(mesh: Mesh) -> None:
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def global_mesh() -> Mesh:
+    """The mesh installed by ``byteps_tpu.jax.init()``."""
+    if _global_mesh is None:
+        raise RuntimeError(
+            "byteps_tpu mesh not initialised — call byteps_tpu.jax.init() "
+            "first")
+    return _global_mesh

@@ -1,0 +1,48 @@
+"""Test harness: simulate an 8-chip topology on CPU.
+
+Reference test strategy (SURVEY.md §4): no mocks — run the real code paths
+on a localhost topology. Our equivalent for the ICI stage is XLA's virtual
+CPU devices (8 devices in one process); the DCN/PS leg is tested with real
+localhost TCP processes in test_kv/test_server (same philosophy: real
+transport, real summation, no fakes).
+
+Must run before any jax import, hence the env mutation at module top.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the env may pre-set a TPU platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize may register a TPU platform and pin it
+# programmatically (which beats the env var), so pin CPU the same way.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_byteps_state():
+    """Each test gets a clean global state and a fresh env snapshot."""
+    yield
+    try:
+        import byteps_tpu.jax as bps
+        if bps.initialized():
+            bps.shutdown()
+    except Exception:
+        pass
+    import byteps_tpu.config as config
+    config._config = None
+    import byteps_tpu.parallel.mesh as mesh_mod
+    mesh_mod._global_mesh = None
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,48 @@
+"""Config-system tests (env-var parity, SURVEY.md §5)."""
+
+import pytest
+
+from byteps_tpu.config import Config, load_config
+
+
+def test_defaults(monkeypatch):
+    for var in ("DMLC_ROLE", "DMLC_NUM_WORKER", "BYTEPS_PARTITION_BYTES"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = load_config()
+    assert cfg.role == "worker"
+    assert cfg.partition_bytes == 4096000
+    assert cfg.scheduling_credit == 4
+    assert not cfg.distributed
+    assert not cfg.use_ps
+
+
+def test_env_parity_names(monkeypatch):
+    monkeypatch.setenv("DMLC_ROLE", "server")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "4")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "2")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "10.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", "1234")
+    monkeypatch.setenv("BYTEPS_PARTITION_BYTES", "1048576")
+    monkeypatch.setenv("BYTEPS_SCHEDULING_CREDIT", "8")
+    monkeypatch.setenv("BYTEPS_ENABLE_ASYNC", "1")
+    monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+    monkeypatch.setenv("BYTEPS_LOG_LEVEL", "debug")
+    cfg = load_config()
+    assert cfg.role == "server"
+    assert cfg.num_worker == 4 and cfg.num_server == 2
+    assert cfg.root_uri == "10.0.0.1" and cfg.root_port == 1234
+    assert cfg.partition_bytes == 1 << 20
+    assert cfg.scheduling_credit == 8
+    assert cfg.enable_async and cfg.force_distributed and cfg.distributed
+    assert cfg.use_ps
+    assert cfg.log_level == "DEBUG"
+
+
+def test_invalid_role():
+    with pytest.raises(ValueError):
+        Config(role="bogus").validate()
+
+
+def test_ps_mode_override():
+    assert not Config(num_server=2, ps_mode="collective").use_ps
+    assert Config(ps_mode="ps").use_ps

@@ -1,0 +1,64 @@
+"""Partitioner tests (reference: InitTensor partitioning, SURVEY.md §2.1)."""
+
+import numpy as np
+import pytest
+
+from byteps_tpu.partition import TensorRegistry, partition_tensor
+
+
+def test_small_tensor_single_partition():
+    e = partition_tensor(0, "w", (10, 10), "float32",
+                         partition_bytes=4096000, num_servers=3, priority=0)
+    assert len(e.partitions) == 1
+    p = e.partitions[0]
+    assert p.offset == 0 and p.length == 100
+    assert p.key == 0
+
+
+def test_large_tensor_partitioning():
+    # 10 M float32 = 40 MB → 10 partitions at 4 MB
+    e = partition_tensor(7, "big", (10_000_000,), "float32",
+                         partition_bytes=4096000, num_servers=4, priority=-7)
+    per = 4096000 // 4
+    assert len(e.partitions) == -(-10_000_000 // per)
+    total = sum(p.length for p in e.partitions)
+    assert total == 10_000_000
+    # contiguity
+    off = 0
+    for p in e.partitions:
+        assert p.offset == off
+        off += p.length
+    # partitions of one tensor spread across servers
+    servers = {p.server for p in e.partitions}
+    assert len(servers) == 4
+    # keys unique and derived from tensor id
+    keys = [p.key for p in e.partitions]
+    assert len(set(keys)) == len(keys)
+    assert all(k >> 16 == 7 for k in keys)
+
+
+def test_server_balance_many_small_tensors():
+    reg = TensorRegistry(partition_bytes=4096000, num_servers=4)
+    for i in range(64):
+        reg.declare(f"t{i}", (8,), "float32")
+    counts = np.zeros(4, int)
+    for e in reg.entries:
+        for p in e.partitions:
+            counts[p.server] += 1
+    assert counts.min() == counts.max() == 16
+
+
+def test_declaration_order_priority():
+    reg = TensorRegistry(partition_bytes=4096000, num_servers=1)
+    a = reg.declare("a", (4,), "float32")
+    b = reg.declare("b", (4,), "float32")
+    assert a.priority > b.priority  # earlier-declared = higher priority
+
+
+def test_redeclare_consistent():
+    reg = TensorRegistry(partition_bytes=4096000, num_servers=1)
+    a1 = reg.declare("a", (4,), "float32")
+    a2 = reg.declare("a", (4,), "float32")
+    assert a1 is a2
+    with pytest.raises(ValueError):
+        reg.declare("a", (5,), "float32")

@@ -1,0 +1,157 @@
+"""push_pull numerics on a virtual 8-device mesh (2 dcn x 4 ici).
+
+Reference coverage model (SURVEY.md §4): push_pull over many shapes/dtypes
+== size x tensor (sum) or tensor (average); broadcast correctness from
+root; handle poll/synchronize semantics.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import byteps_tpu.jax as bps
+from byteps_tpu.parallel.mesh import MeshSpec, build_mesh
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def _init(dcn=2, ici=4):
+    mesh = build_mesh(MeshSpec(dcn=dcn, ici=ici))
+    bps.init(mesh=mesh)
+    return mesh
+
+
+@pytest.mark.parametrize("shape", [(8,), (3, 5), (1,), (17, 3, 2), (128, 9)])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int32"])
+def test_push_pull_sum_matches_numpy(shape, dtype):
+    _init()
+    n = 8
+    rng = np.random.default_rng(42)
+    if dtype == "int32":
+        vals = rng.integers(-10, 10, size=(n,) + shape).astype(dtype)
+    else:
+        vals = rng.standard_normal((n,) + shape).astype("float32")
+    x = jnp.asarray(vals).astype(dtype)
+    out = bps.push_pull(x, average=False)
+    expect = np.asarray(vals.astype("float64").sum(0))
+    np.testing.assert_allclose(
+        np.asarray(out, dtype="float64"), expect,
+        rtol=3e-2 if dtype == "bfloat16" else 1e-5,
+        atol=3e-2 if dtype == "bfloat16" else 1e-5)
+
+
+def test_push_pull_average():
+    _init()
+    x = jnp.stack([jnp.full((6, 7), float(i)) for i in range(8)])
+    out = bps.push_pull(x, average=True)
+    np.testing.assert_allclose(np.asarray(out), np.full((6, 7), 3.5), rtol=1e-6)
+
+
+def test_push_pull_tree_fused():
+    _init()
+    rng = np.random.default_rng(0)
+    tree = {
+        "w": jnp.asarray(rng.standard_normal((8, 4, 3)), jnp.float32),
+        "b": jnp.asarray(rng.standard_normal((8, 5)), jnp.float32),
+        "nested": {"k": jnp.asarray(rng.standard_normal((8, 2, 2, 2)),
+                                    jnp.float32)},
+    }
+    out = bps.push_pull(tree, average=False)
+    flat_in, treedef_in = jax.tree_util.tree_flatten(tree)
+    flat_out, treedef_out = jax.tree_util.tree_flatten(out)
+    assert treedef_in == treedef_out
+    for i, o in zip(flat_in, flat_out):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(i).sum(0),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_push_pull_inside_shard_map():
+    """The hot path: push_pull called from per-device code in a jitted
+    shard_map'd train-step-like function."""
+    mesh = _init()
+    n = 8
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=P(("dcn", "ici")),
+             out_specs=P(("dcn", "ici")))
+    def step(x):
+        local = x  # [1, 5] shard per device
+        g = bps.push_pull(local, average=True)
+        return g
+
+    x = jnp.arange(n * 5, dtype=jnp.float32).reshape(n, 5)
+    out = step(x)
+    # every device shard should hold the mean over the replica axis
+    expect = np.tile(np.asarray(x).reshape(n, 5).mean(0), (n, 1)).reshape(n, 5)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+
+def test_push_pull_ici_only_mesh():
+    _init(dcn=1, ici=8)
+    x = jnp.stack([jnp.full((3,), float(i + 1)) for i in range(8)])
+    out = bps.push_pull(x, average=False)
+    np.testing.assert_allclose(np.asarray(out), np.full((3,), 36.0))
+
+
+def test_push_pull_odd_sizes_padding():
+    """Sizes not divisible by ici axis exercise the padding path."""
+    _init(dcn=2, ici=4)
+    x = jnp.stack([jnp.full((7,), float(i)) for i in range(8)])  # 7 % 4 != 0
+    out = bps.push_pull(x, average=False)
+    np.testing.assert_allclose(np.asarray(out), np.full((7,), 28.0))
+
+
+def test_async_handles():
+    _init()
+    x = jnp.ones((8, 4))
+    h = bps.push_pull_async(x, average=False)
+    res = bps.synchronize(h)
+    assert bps.poll(h)
+    np.testing.assert_allclose(np.asarray(res), np.full((4,), 8.0))
+
+
+def test_wire_compression_bf16():
+    _init()
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((8, 33)), jnp.float32)
+    out = bps.push_pull(x, average=True, compression=bps.Compression.bf16)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x).mean(0),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_broadcast_parameters_inside_shard_map():
+    mesh = _init(dcn=2, ici=4)
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=P(("dcn", "ici")),
+             out_specs=P(("dcn", "ici")))
+    def bcast(x):
+        return bps.broadcast_parameters(x, root_rank=3)
+
+    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+    out = bcast(x)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.0))
+
+
+def test_topology_queries():
+    _init()
+    # Horovod invariant: rank() in [0, size()) at the process level.
+    assert bps.size() == jax.process_count() == 1
+    assert bps.rank() == 0
+    assert 0 <= bps.rank() < bps.size()
+    # chip-level count is separate (the averaging denominator)
+    assert bps.device_count() == 8
+    assert bps.local_size() == 8
+
+
+def test_requires_init():
+    with pytest.raises(RuntimeError):
+        bps.size()
