@@ -1,0 +1,113 @@
+"""Benchmark: flagship ResNet-50 training throughput through byteps_tpu.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference's headline benchmark is synthetic-data ResNet-50 throughput
+(example/pytorch/benchmark_byteps.py, SURVEY.md §2.6). Run on however many
+chips are visible (driver: one real TPU chip). ``vs_baseline`` compares the
+byteps_tpu step (full framework path: hierarchical push_pull + optimizer in
+the jitted program) against a plain-JAX step with no gradient-sync
+framework — i.e. the framework's sync efficiency on this hardware; 1.0
+means zero overhead vs raw JAX, matching the ≥0.9 scaling north star in
+BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=0, help="global batch "
+                   "(default: 64 per chip)")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes for a fast correctness pass")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import byteps_tpu.jax as bps
+    from byteps_tpu.jax.flax_util import make_flax_train_step
+    from byteps_tpu.jax.training import replicate, shard_batch
+    from byteps_tpu.models import ResNet18, ResNet50
+
+    n_dev = len(jax.devices())
+    if args.smoke:
+        model_cls, img, batch = ResNet18, 64, max(8, n_dev)
+        args.steps = min(args.steps, 5)
+    else:
+        model_cls, img = ResNet50, args.image_size
+        batch = args.batch or 64 * n_dev
+
+    model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, img, img, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 1000, batch), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x[:1], train=False)
+    tx = optax.sgd(0.1, momentum=0.9)
+
+    def timed(step, state, batch_parts):
+        state = step(*state, batch_parts)  # warm compile
+        for _ in range(args.warmup - 1):
+            state = step(*state[:-1], batch_parts)
+        jax.block_until_ready(state)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state = step(*state[:-1], batch_parts)
+        jax.block_until_ready(state)
+        dt = time.perf_counter() - t0
+        return batch * args.steps / dt
+
+    # --- byteps_tpu path ---
+    bps.init()
+    mesh = bps.mesh()
+    step = make_flax_train_step(model.apply, tx, mesh)
+    state = (replicate(variables["params"], mesh),
+             replicate(variables["batch_stats"], mesh),
+             replicate(tx.init(variables["params"]), mesh))
+    bench_ips = timed(step, state, shard_batch((x, y), mesh))
+
+    # --- plain JAX baseline (no sync framework) ---
+    from byteps_tpu.jax.flax_util import cross_entropy_loss
+
+    @jax.jit
+    def plain_step(params, batch_stats, opt_state, batch):
+        bx, by = batch
+
+        def loss_fn(p):
+            out, new_state = model.apply(
+                {"params": p, "batch_stats": batch_stats}, bx, train=True,
+                mutable=["batch_stats"])
+            return cross_entropy_loss(out, by), new_state["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, loss
+
+    state2 = (variables["params"], variables["batch_stats"],
+              tx.init(variables["params"]))
+    plain_ips = timed(plain_step, state2, (x, y))
+
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip"
+                  if not args.smoke else "resnet18_smoke_imgs_per_sec",
+        "value": round(bench_ips / n_dev, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(bench_ips / plain_ips, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Build the byteps_tpu C++ core into libbyteps_core.so.
+
+Run as ``python -m byteps_tpu.core.build`` (reference analogue: the
+setup.py c_lib extension build, SURVEY.md §2.6). No external deps — plain
+g++; OpenMP is enabled when available (the PS summation hot loop,
+cpu_reducer.cc, parallelises across the server's spare cores).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+CORE_DIR = os.path.dirname(os.path.abspath(__file__))
+CSRC = os.path.join(CORE_DIR, "csrc")
+LIB_PATH = os.path.join(CORE_DIR, "libbyteps_core.so")
+
+SOURCES = [
+    "debug.cc",
+    "van.cc",
+    "postoffice.cc",
+    "cpu_reducer.cc",
+    "compressor.cc",
+    "server.cc",
+    "worker.cc",
+    "c_api.cc",
+]
+
+
+def _supports_flag(cxx: str, flag: str) -> bool:
+    probe = subprocess.run(
+        [cxx, flag, "-x", "c++", "-", "-fsyntax-only"],
+        input="int main(){return 0;}", text=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    return probe.returncode == 0
+
+
+def build(force: bool = False, verbose: bool = True) -> str:
+    """Compile if sources are newer than the library. Returns the lib path."""
+    srcs = [os.path.join(CSRC, s) for s in SOURCES]
+    hdrs = [os.path.join(CSRC, h) for h in os.listdir(CSRC)
+            if h.endswith(".h")]
+    if not force and os.path.exists(LIB_PATH):
+        lib_mtime = os.path.getmtime(LIB_PATH)
+        if all(os.path.getmtime(f) < lib_mtime for f in srcs + hdrs):
+            return LIB_PATH
+
+    cxx = os.environ.get("CXX", "g++")
+    flags = ["-O3", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
+    for extra in ("-march=native", "-fopenmp"):
+        if _supports_flag(cxx, extra):
+            flags.append(extra)
+    cmd = [cxx, *flags, *srcs, "-o", LIB_PATH]
+    if verbose:
+        print("[byteps_tpu.core.build]", " ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return LIB_PATH
+
+
+if __name__ == "__main__":
+    build(force="--force" in sys.argv)
+    print(LIB_PATH)

@@ -1,0 +1,202 @@
+// extern "C" surface loaded by byteps_tpu.core.ffi via ctypes.
+//
+// Capability parity: reference byteps/common/operations.{h,cc} public C
+// entry points (byteps_init / byteps_declare_tensor / EnqueueTensor /
+// byteps_rank / ...; SURVEY.md §2.1) — env-var configured exactly like the
+// reference (DMLC_* / BYTEPS_* families, docs/ENV.md).
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <string>
+
+#include "common.h"
+#include "debug.h"
+#include "kv.h"
+#include "logging.h"
+#include "postoffice.h"
+#include "server.h"
+#include "worker.h"
+
+namespace {
+
+using namespace bps;
+
+struct Global {
+  std::unique_ptr<Postoffice> po;
+  std::unique_ptr<KVWorker> kv;
+  std::unique_ptr<BytePSServer> server;
+  std::unique_ptr<BytePSWorker> worker;
+  Role role = ROLE_WORKER;
+  bool inited = false;
+};
+
+Global* g() {
+  static Global inst;
+  return &inst;
+}
+
+int EnvInt(const char* name, int dflt) {
+  const char* v = getenv(name);
+  return v && *v ? atoi(v) : dflt;
+}
+
+int64_t EnvInt64(const char* name, int64_t dflt) {
+  const char* v = getenv(name);
+  return v && *v ? atoll(v) : dflt;
+}
+
+std::string EnvStr(const char* name, const char* dflt) {
+  const char* v = getenv(name);
+  return v && *v ? v : dflt;
+}
+
+bool EnvBool(const char* name) {
+  const char* v = getenv(name);
+  if (!v || !*v) return false;
+  return strcmp(v, "0") != 0 && strcasecmp(v, "false") != 0;
+}
+
+// Build the default compressor config string from env (reference:
+// byteps_compressor_type / _k / ef_type / momentum_type params).
+std::string DefaultCompConfig() {
+  std::string type = EnvStr("BYTEPS_COMPRESSOR", "");
+  if (type.empty()) return "";
+  std::string cfg = "type=" + type;
+  int64_t k = EnvInt64("BYTEPS_COMPRESSOR_K", 0);
+  if (k > 0) cfg += ";k=" + std::to_string(k);
+  std::string ef = EnvStr("BYTEPS_ERROR_FEEDBACK", "");
+  if (!ef.empty()) cfg += ";ef=" + ef;
+  std::string mom = EnvStr("BYTEPS_MOMENTUM", "");
+  if (!mom.empty()) {
+    cfg += ";momentum=" + mom;
+    cfg += ";mu=" + EnvStr("BYTEPS_MOMENTUM_MU", "0.9");
+  }
+  return cfg;
+}
+
+}  // namespace
+
+extern "C" {
+
+// role: 0 scheduler, 1 server, 2 worker (Role enum). Returns node id, <0 on
+// error. All other configuration comes from the environment for parity with
+// the reference (see byteps_tpu/config.py and docs/ENV.md).
+int bps_init(int role) {
+  InstallCrashHandler();
+  Global* gl = g();
+  BPS_CHECK(!gl->inited) << "bps_init called twice";
+  // Fresh state per init so a process can re-init after finalize (tests).
+  gl->worker.reset();
+  gl->server.reset();
+  gl->kv.reset();
+  gl->po = std::make_unique<Postoffice>();
+  gl->role = static_cast<Role>(role);
+  std::string uri = EnvStr("DMLC_PS_ROOT_URI", "127.0.0.1");
+  int port = EnvInt("DMLC_PS_ROOT_PORT", 9000);
+  int nw = EnvInt("DMLC_NUM_WORKER", 1);
+  int ns = EnvInt("DMLC_NUM_SERVER", 1);
+
+  Postoffice::AppHandler handler;
+  if (gl->role == ROLE_SERVER) {
+    gl->server = std::make_unique<BytePSServer>();
+    // Engine threads must exist BEFORE the postoffice starts accepting:
+    // a fast worker can deliver INIT_KEY the moment the address book is
+    // broadcast, racing a not-yet-started engine.
+    gl->server->Start(gl->po.get(), EnvInt("BYTEPS_SERVER_ENGINE_THREAD", 4),
+                      EnvBool("BYTEPS_ENABLE_ASYNC"));
+    handler = [gl](Message&& m, int fd) {
+      gl->server->Handle(std::move(m), fd);
+    };
+  } else if (gl->role == ROLE_WORKER) {
+    gl->kv = std::make_unique<KVWorker>(gl->po.get());
+    handler = [gl](Message&& m, int fd) {
+      (void)fd;
+      gl->kv->OnResponse(std::move(m));
+    };
+    gl->po->SetShutdownCallback([gl] { gl->kv->FailAllPending(); });
+  }
+
+  int id = gl->po->Start(gl->role, uri, port, nw, ns, std::move(handler));
+  if (gl->role == ROLE_WORKER) {
+    gl->worker = std::make_unique<BytePSWorker>();
+    gl->worker->Start(gl->po.get(), gl->kv.get(),
+                      EnvInt64("BYTEPS_PARTITION_BYTES", 4096000),
+                      EnvInt("BYTEPS_SCHEDULING_CREDIT", 4),
+                      DefaultCompConfig(), EnvBool("BYTEPS_TRACE_ON"));
+  }
+  gl->inited = true;
+  return id;
+}
+
+void bps_finalize() {
+  Global* gl = g();
+  if (!gl->inited) return;
+  if (gl->worker) gl->worker->Stop();
+  gl->po->Finalize();
+  if (gl->server) gl->server->Stop();
+  gl->inited = false;
+}
+
+int bps_my_id() { return g()->po->my_id(); }
+int bps_worker_rank() { return g()->po->my_worker_rank(); }
+int bps_num_workers() { return g()->po->num_workers(); }
+int bps_num_servers() { return g()->po->num_servers(); }
+
+void bps_barrier(int group) { g()->po->Barrier(group); }
+
+long long bps_declare(const char* name, long long nelem, int dtype,
+                      const char* comp_config) {
+  return g()->worker->Declare(name, nelem, dtype,
+                              comp_config ? comp_config : "__default__");
+}
+
+int bps_push_pull(long long tensor_id, void* ptr, long long nelem, int dtype,
+                  int average, int async_mode) {
+  return g()->worker->PushPull(tensor_id, ptr, nelem, dtype, average != 0,
+                               async_mode != 0);
+}
+
+int bps_broadcast(long long tensor_id, void* ptr, long long nelem, int dtype,
+                  int root) {
+  return g()->worker->Broadcast(tensor_id, ptr, nelem, dtype, root);
+}
+
+void bps_wait(int handle) { g()->worker->Wait(handle); }
+int bps_poll(int handle) { return g()->worker->Poll(handle) ? 1 : 0; }
+
+// Dump accumulated trace events as Chrome trace-event JSON (reference:
+// BYTEPS_TRACE_ON timeline, SURVEY.md §5). Returns number of events.
+int bps_dump_trace(const char* path) {
+  Global* gl = g();
+  if (!gl->worker) return -1;
+  auto events = gl->worker->DrainTrace();
+  FILE* f = fopen(path, "w");
+  if (!f) return -1;
+  fprintf(f, "{\"traceEvents\":[\n");
+  int rank = gl->po->my_worker_rank();
+  for (size_t i = 0; i < events.size(); ++i) {
+    const auto& e = events[i];
+    fprintf(f,
+            "{\"name\":\"%s\",\"ph\":\"X\",\"pid\":%d,\"tid\":%lld,"
+            "\"ts\":%lld,\"dur\":%lld,\"args\":{\"key\":%lld}}%s\n",
+            e.stage, rank, static_cast<long long>(e.key),
+            static_cast<long long>(e.ts_us), static_cast<long long>(e.dur_us),
+            static_cast<long long>(e.key), i + 1 < events.size() ? "," : "");
+  }
+  fprintf(f, "]}\n");
+  fclose(f);
+  return static_cast<int>(events.size());
+}
+
+// Scheduler-side failure detection: ids of nodes with expired heartbeats.
+int bps_dead_nodes(int* out, int max) {
+  auto dead = g()->po->DeadNodes();
+  int n = 0;
+  for (int id : dead) {
+    if (n >= max) break;
+    out[n++] = id;
+  }
+  return n;
+}
+
+}  // extern "C"

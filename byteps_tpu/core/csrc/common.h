@@ -1,0 +1,112 @@
+// Shared types and wire format for the byteps_tpu C++ core.
+//
+// Capability parity: reference byteps/common/common.h (TensorTableEntry,
+// QueueType, DataType) + ps-lite Meta/SArray wire conventions — see
+// SURVEY.md §2.1/§2.4. The wire format here is a fresh design: one fixed
+// packed header per message followed by an opaque payload, framed over TCP.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace bps {
+
+// --- data types -------------------------------------------------------------
+
+enum DataType : int32_t {
+  BPS_FLOAT32 = 0,
+  BPS_FLOAT64 = 1,
+  BPS_FLOAT16 = 2,
+  BPS_BFLOAT16 = 3,
+  BPS_INT32 = 4,
+  BPS_INT64 = 5,
+  BPS_UINT8 = 6,
+  BPS_INT8 = 7,
+};
+
+inline int DtypeSize(int32_t dt) {
+  switch (dt) {
+    case BPS_FLOAT32: case BPS_INT32: return 4;
+    case BPS_FLOAT64: case BPS_INT64: return 8;
+    case BPS_FLOAT16: case BPS_BFLOAT16: return 2;
+    case BPS_UINT8: case BPS_INT8: return 1;
+    default: return 0;
+  }
+}
+
+// --- node roles & ids -------------------------------------------------------
+
+enum Role : int32_t {
+  ROLE_SCHEDULER = 0,
+  ROLE_SERVER = 1,
+  ROLE_WORKER = 2,
+};
+
+constexpr int32_t kSchedulerId = 0;  // scheduler is always node 0
+
+// --- message commands -------------------------------------------------------
+
+enum Command : int32_t {
+  CMD_REGISTER = 1,      // node -> scheduler: role + listen addr
+  CMD_ADDRBOOK = 2,      // scheduler -> all: assigned id + address book
+  CMD_BARRIER = 3,       // node -> scheduler
+  CMD_BARRIER_ACK = 4,   // scheduler -> node
+  CMD_PUSH = 5,          // worker -> server: gradient partition payload
+  CMD_PUSH_ACK = 6,      // server -> worker
+  CMD_PULL = 7,          // worker -> server: request aggregate
+  CMD_PULL_RESP = 8,     // server -> worker: aggregate payload
+  CMD_INIT_KEY = 9,      // worker -> server: declare key (len, dtype)
+  CMD_INIT_ACK = 10,     // server -> worker
+  CMD_HEARTBEAT = 11,    // node -> scheduler
+  CMD_SHUTDOWN = 12,     // scheduler -> all (graceful teardown)
+  CMD_BCAST_PUSH = 13,   // worker -> server: root pushes initial value
+  CMD_BCAST_PULL = 14,   // worker -> server: non-root pulls initial value
+};
+
+// --- message flags ----------------------------------------------------------
+
+enum MsgFlags : int32_t {
+  FLAG_COMPRESSED = 1 << 0,  // payload is compressor output
+  FLAG_ASYNC = 1 << 1,       // async-mode operation
+};
+
+// --- wire header ------------------------------------------------------------
+// Every frame on the wire is: uint64 total_len | MsgHeader | payload bytes.
+// total_len counts header + payload. Integers are host-endian (all nodes are
+// little-endian x86/ARM Linux in scope).
+
+#pragma pack(push, 1)
+struct MsgHeader {
+  int32_t cmd = 0;
+  int32_t sender = -1;     // node id (-1 before registration)
+  int64_t key = 0;         // partition key
+  int32_t req_id = -1;     // request id for matching responses
+  int32_t dtype = 0;
+  int64_t payload_len = 0;  // bytes following the header
+  int32_t flags = 0;
+  int32_t version = 0;     // round parity slot (sync double-buffering)
+  int64_t arg0 = 0;        // cmd-specific (e.g. decompressed len for PUSH,
+                           // listen port for REGISTER, count for BARRIER)
+  int64_t arg1 = 0;        // cmd-specific (e.g. role for REGISTER)
+};
+#pragma pack(pop)
+
+struct Message {
+  MsgHeader head;
+  std::vector<char> payload;  // owned receive buffer
+};
+
+// --- node descriptor (address book entry) -----------------------------------
+
+#pragma pack(push, 1)
+struct NodeInfo {
+  int32_t id;
+  int32_t role;
+  char host[64];
+  int32_t port;
+};
+#pragma pack(pop)
+
+}  // namespace bps

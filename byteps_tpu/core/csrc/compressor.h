@@ -1,0 +1,44 @@
+// Gradient compression plugin framework.
+//
+// Capability parity: reference byteps/common/compressor/ (SURVEY.md §2.2):
+// Compressor base + registry keyed by per-tensor param strings, algorithms
+// onebit / topk / randomk / dithering, decorators error-feedback (residual
+// accumulation) and momentum (nesterov), applied on host buffers at the
+// push boundary; the server decompresses, sums, and serves raw aggregates.
+//
+// Config string grammar (passed through declare_tensor, parity with the
+// reference's byteps_compressor_* params):
+//   "type=onebit" | "type=topk;k=32" | "type=randomk;k=32;seed=7" |
+//   "type=dithering;bits=8"  — optionally with ";ef=vanilla" and/or
+//   ";momentum=nesterov;mu=0.9" decorators.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace bps {
+
+class Compressor {
+ public:
+  virtual ~Compressor() = default;
+  // Encode `n` float32 elements of src into out (resized to compressed size).
+  virtual void Compress(const float* src, int64_t n,
+                        std::vector<char>* out) = 0;
+  // Decode into dst (n float32 elements, overwritten).
+  virtual void Decompress(const char* src, int64_t src_bytes, float* dst,
+                          int64_t n) = 0;
+};
+
+// Parse a config string and build the (possibly decorated) compressor for a
+// partition of `n` elements. Returns nullptr for empty/absent type.
+std::unique_ptr<Compressor> CreateCompressor(const std::string& config,
+                                             int64_t n);
+
+// Parsed key=value view of a config string (exposed for tests).
+std::unordered_map<std::string, std::string> ParseCompressorConfig(
+    const std::string& config);
+
+}  // namespace bps

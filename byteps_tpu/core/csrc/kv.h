@@ -1,0 +1,103 @@
+// Awaitable request/response bookkeeping over the van.
+//
+// Capability parity: reference ps-lite Customer + KVWorker<char>::ZPush/
+// ZPull (SURVEY.md §2.4): zero-copy request issue (payload bytes go from
+// the caller's buffer straight to writev), request-id matching of
+// responses, callback-or-wait completion. KVServer-side dispatch lives in
+// server.h; this class is the worker-side half.
+#pragma once
+
+#include <condition_variable>
+#include <functional>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "logging.h"
+#include "postoffice.h"
+
+namespace bps {
+
+class KVWorker {
+ public:
+  using Callback = std::function<void(Message&&)>;
+
+  explicit KVWorker(Postoffice* po) : po_(po) {}
+
+  // Issue a request to `node_id`; `cb` fires on the van receive thread when
+  // the matching response (same req_id) arrives. Returns the req id.
+  int Request(int node_id, MsgHeader head, const void* payload,
+              int64_t payload_len, Callback cb) {
+    int rid;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      rid = next_req_id_++;
+      pending_[rid] = std::move(cb);
+    }
+    head.sender = po_->my_id();
+    head.req_id = rid;
+    po_->van().Send(po_->FdOf(node_id), head, payload, payload_len);
+    return rid;
+  }
+
+  // Route a response message (PUSH_ACK / PULL_RESP / INIT_ACK / ...).
+  void OnResponse(Message&& msg) {
+    Callback cb;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = pending_.find(msg.head.req_id);
+      if (it == pending_.end()) return;  // late/duplicate response
+      cb = std::move(it->second);
+      pending_.erase(it);
+      done_count_++;
+    }
+    if (cb) cb(std::move(msg));
+    cv_.notify_all();
+  }
+
+  // Block until there are no outstanding requests.
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [this] { return pending_.empty(); });
+  }
+
+  // Block until the given request ids have all completed (does NOT wait on
+  // unrelated in-flight requests — a late Declare must not serialize
+  // against the previous round's pushes).
+  void WaitRequests(const std::vector<int>& ids) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [this, &ids] {
+      for (int id : ids) {
+        if (pending_.count(id)) return false;
+      }
+      return true;
+    });
+  }
+
+  // Fail-stop on fleet shutdown with work in flight (a peer died and the
+  // scheduler broadcast failure shutdown): crashing with a clear message
+  // beats hanging forever on responses that will never come.
+  void FailAllPending() {
+    size_t n;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      n = pending_.size();
+    }
+    if (n > 0) {
+      BPS_FATAL << "fleet shutdown with " << n
+                << " request(s) in flight — a server or worker died "
+                   "(see scheduler log); restart the job";
+    }
+  }
+
+ private:
+  Postoffice* po_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::unordered_map<int, Callback> pending_;
+  int next_req_id_ = 0;
+  int64_t done_count_ = 0;
+};
+
+}  // namespace bps

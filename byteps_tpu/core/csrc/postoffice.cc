@@ -1,0 +1,348 @@
+#include "postoffice.h"
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstring>
+
+#include "logging.h"
+
+namespace bps {
+
+int64_t NowMs() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+static double EnvSeconds(const char* name, double dflt) {
+  const char* v = getenv(name);
+  return v && *v ? atof(v) : dflt;
+}
+
+int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
+                      int num_workers, int num_servers,
+                      AppHandler app_handler) {
+  role_ = role;
+  num_workers_ = num_workers;
+  num_servers_ = num_servers;
+  app_handler_ = std::move(app_handler);
+  van_ = std::make_unique<Van>(
+      [this](Message&& m, int fd) { ControlHandler(std::move(m), fd); });
+
+  if (role == ROLE_SCHEDULER) {
+    my_id_ = kSchedulerId;
+    van_->Listen(root_port);
+    // Wait for everyone to register; ControlHandler completes the handshake.
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [this] { return addrbook_ready_; });
+  } else {
+    int listen_port = van_->Listen(0);
+    int fd = van_->Connect(root_uri, root_port);
+    BPS_CHECK_GE(fd, 0) << "cannot reach scheduler at " << root_uri << ":"
+                        << root_port;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      node_fd_[kSchedulerId] = fd;
+    }
+    NodeInfo me{};
+    me.id = -1;
+    me.role = role;
+    const char* host_env = getenv("DMLC_NODE_HOST");
+    snprintf(me.host, sizeof(me.host), "%s",
+             host_env && *host_env ? host_env : "127.0.0.1");
+    me.port = listen_port;
+    MsgHeader h{};
+    h.cmd = CMD_REGISTER;
+    h.sender = -1;
+    const char* wid = getenv("DMLC_WORKER_ID");
+    h.arg0 = wid && *wid ? atol(wid) : -1;  // preferred rank (deterministic)
+    h.arg1 = role;
+    van_->Send(fd, h, &me, sizeof(me));
+    // Wait for the address book.
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [this] { return addrbook_ready_; });
+    lk.unlock();
+    if (role == ROLE_WORKER) {
+      // Dial every server; identify ourselves on each connection.
+      for (const auto& n : nodes_) {
+        if (n.role != ROLE_SERVER) continue;
+        int sfd = van_->Connect(n.host, n.port);
+        BPS_CHECK_GE(sfd, 0) << "cannot reach server " << n.id;
+        MsgHeader hello{};
+        hello.cmd = CMD_REGISTER;
+        hello.sender = my_id_;
+        hello.arg1 = ROLE_WORKER;
+        van_->Send(sfd, hello);
+        std::lock_guard<std::mutex> lk2(mu_);
+        node_fd_[n.id] = sfd;
+      }
+    }
+  }
+
+  double interval = EnvSeconds("PS_HEARTBEAT_INTERVAL", 5.0);
+  if (role != ROLE_SCHEDULER && interval > 0) {
+    heartbeat_thread_ = std::thread([this] { HeartbeatLoop(); });
+  }
+  if (role == ROLE_SCHEDULER && interval > 0) {
+    // Failure detection (reference: ps-lite heartbeat timeout, SURVEY.md
+    // §5): a node missing heartbeats past PS_HEARTBEAT_TIMEOUT takes the
+    // fleet down fail-stop — the cluster manager owns the restart.
+    monitor_thread_ = std::thread([this, interval] {
+      while (!shutting_down_.load()) {
+        for (int i = 0; i < static_cast<int>(interval * 10) &&
+                        !shutting_down_.load();
+             ++i) {
+          usleep(100 * 1000);
+        }
+        if (shutting_down_.load()) return;
+        auto dead = DeadNodes();
+        if (!dead.empty()) {
+          std::string ids;
+          for (int id : dead) ids += std::to_string(id) + " ";
+          BPS_LOG(WARNING) << "scheduler: node(s) " << ids
+                           << "missed heartbeats — broadcasting shutdown";
+          MsgHeader h{};
+          h.cmd = CMD_SHUTDOWN;
+          h.sender = kSchedulerId;
+          h.arg0 = 1;  // failure-triggered
+          std::lock_guard<std::mutex> lk(mu_);
+          for (const auto& n : nodes_) {
+            if (n.id == kSchedulerId) continue;
+            auto it = node_fd_.find(n.id);
+            if (it != node_fd_.end()) van_->Send(it->second, h);
+          }
+          shutting_down_.store(true);
+          cv_.notify_all();
+          return;
+        }
+      }
+    });
+  }
+  BPS_LOG(INFO) << "node started: role=" << role << " id=" << my_id_;
+  return my_id_;
+}
+
+void Postoffice::ControlHandler(Message&& msg, int fd) {
+  switch (msg.head.cmd) {
+    case CMD_REGISTER: {
+      if (role_ == ROLE_SCHEDULER) {
+        std::unique_lock<std::mutex> lk(mu_);
+        BPS_CHECK_EQ(msg.payload.size(), sizeof(NodeInfo));
+        PendingReg pr;
+        pr.fd = fd;
+        memcpy(&pr.info, msg.payload.data(), sizeof(NodeInfo));
+        pr.info.id = static_cast<int32_t>(msg.head.arg0);  // preferred rank
+        pending_regs_.push_back(pr);
+        if (static_cast<int>(pending_regs_.size()) ==
+            num_workers_ + num_servers_) {
+          // Assign ids: deterministic by (role, preferred rank, arrival).
+          std::stable_sort(pending_regs_.begin(), pending_regs_.end(),
+                           [](const PendingReg& a, const PendingReg& b) {
+                             if (a.info.role != b.info.role)
+                               return a.info.role < b.info.role;
+                             return a.info.id < b.info.id;
+                           });
+          nodes_.clear();
+          NodeInfo sched{};
+          sched.id = kSchedulerId;
+          sched.role = ROLE_SCHEDULER;
+          nodes_.push_back(sched);
+          int next_server = 0, next_worker = 0;
+          for (auto& pr2 : pending_regs_) {
+            int id = pr2.info.role == ROLE_SERVER
+                         ? ServerId(next_server++)
+                         : WorkerId(next_worker++);
+            pr2.info.id = id;
+            nodes_.push_back(pr2.info);
+            node_fd_[id] = pr2.fd;
+            last_heartbeat_ms_[id] = NowMs();
+          }
+          for (auto& pr2 : pending_regs_) {
+            MsgHeader h{};
+            h.cmd = CMD_ADDRBOOK;
+            h.sender = kSchedulerId;
+            h.arg0 = pr2.info.id;  // your assigned id
+            van_->Send(pr2.fd, h, nodes_.data(),
+                       nodes_.size() * sizeof(NodeInfo));
+          }
+          addrbook_ready_ = true;
+          cv_.notify_all();
+          BPS_LOG(INFO) << "scheduler: topology complete (" << num_workers_
+                        << " workers, " << num_servers_ << " servers)";
+        }
+      } else {
+        // Server side: a worker identifying itself on a fresh connection.
+        std::lock_guard<std::mutex> lk(mu_);
+        node_fd_[msg.head.sender] = fd;
+      }
+      break;
+    }
+    case CMD_ADDRBOOK: {
+      std::lock_guard<std::mutex> lk(mu_);
+      my_id_ = static_cast<int>(msg.head.arg0);
+      size_t n = msg.payload.size() / sizeof(NodeInfo);
+      nodes_.resize(n);
+      memcpy(nodes_.data(), msg.payload.data(), n * sizeof(NodeInfo));
+      addrbook_ready_ = true;
+      cv_.notify_all();
+      break;
+    }
+    case CMD_BARRIER: {
+      BPS_CHECK_EQ(role_, ROLE_SCHEDULER);
+      int group = static_cast<int>(msg.head.arg0);
+      std::lock_guard<std::mutex> lk(mu_);
+      int need = ((group & GROUP_SERVERS) ? num_servers_ : 0) +
+                 ((group & GROUP_WORKERS) ? num_workers_ : 0);
+      if (++barrier_counts_[group] == need) {
+        barrier_counts_[group] = 0;
+        MsgHeader h{};
+        h.cmd = CMD_BARRIER_ACK;
+        h.sender = kSchedulerId;
+        h.arg0 = group;
+        for (const auto& n : nodes_) {
+          bool in_group =
+              (n.role == ROLE_SERVER && (group & GROUP_SERVERS)) ||
+              (n.role == ROLE_WORKER && (group & GROUP_WORKERS));
+          if (in_group) van_->Send(node_fd_[n.id], h);
+        }
+      }
+      break;
+    }
+    case CMD_BARRIER_ACK: {
+      std::lock_guard<std::mutex> lk(mu_);
+      barrier_done_[static_cast<int>(msg.head.arg0)]++;
+      cv_.notify_all();
+      break;
+    }
+    case CMD_HEARTBEAT: {
+      std::lock_guard<std::mutex> lk(mu_);
+      last_heartbeat_ms_[msg.head.sender] = NowMs();
+      break;
+    }
+    case CMD_SHUTDOWN: {
+      if (role_ == ROLE_SCHEDULER) {
+        // A worker says goodbye; when all workers are done, stop the fleet.
+        std::lock_guard<std::mutex> lk(mu_);
+        // A cleanly-departing node is not a failure: stop tracking it.
+        last_heartbeat_ms_.erase(msg.head.sender);
+        if (++barrier_counts_[-1] == num_workers_) {
+          MsgHeader h{};
+          h.cmd = CMD_SHUTDOWN;
+          h.sender = kSchedulerId;
+          for (const auto& n : nodes_) {
+            if (n.id != kSchedulerId) van_->Send(node_fd_[n.id], h);
+          }
+          shutting_down_.store(true);
+          cv_.notify_all();
+        }
+      } else {
+        shutting_down_.store(true);
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          cv_.notify_all();
+        }
+        if (shutdown_cb_) shutdown_cb_();
+      }
+      break;
+    }
+    default:
+      if (app_handler_) app_handler_(std::move(msg), fd);
+  }
+}
+
+void Postoffice::Barrier(int group) {
+  int target;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    target = barrier_done_[group] + 1;
+  }
+  MsgHeader h{};
+  h.cmd = CMD_BARRIER;
+  h.sender = my_id_;
+  h.arg0 = group;
+  van_->Send(FdOf(kSchedulerId), h);
+  std::unique_lock<std::mutex> lk(mu_);
+  cv_.wait(lk, [this, group, target] {
+    return barrier_done_[group] >= target || shutting_down_.load();
+  });
+}
+
+int Postoffice::FdOf(int node_id) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = node_fd_.find(node_id);
+  BPS_CHECK(it != node_fd_.end()) << "no connection to node " << node_id;
+  return it->second;
+}
+
+void Postoffice::HeartbeatLoop() {
+  double interval = EnvSeconds("PS_HEARTBEAT_INTERVAL", 5.0);
+  while (!shutting_down_.load() && !van_->stopped()) {
+    MsgHeader h{};
+    h.cmd = CMD_HEARTBEAT;
+    h.sender = my_id_;
+    int fd = -1;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = node_fd_.find(kSchedulerId);
+      if (it == node_fd_.end()) break;
+      fd = it->second;
+    }
+    if (!van_->Send(fd, h)) break;
+    for (int i = 0; i < static_cast<int>(interval * 10) &&
+                    !shutting_down_.load();
+         ++i) {
+      usleep(100 * 1000);
+    }
+  }
+}
+
+std::vector<int> Postoffice::DeadNodes() {
+  double timeout_ms = EnvSeconds("PS_HEARTBEAT_TIMEOUT", 30.0) * 1000.0;
+  std::vector<int> dead;
+  std::lock_guard<std::mutex> lk(mu_);
+  int64_t now = NowMs();
+  for (const auto& kv : last_heartbeat_ms_) {
+    if (now - kv.second > timeout_ms) dead.push_back(kv.first);
+  }
+  std::sort(dead.begin(), dead.end());
+  return dead;
+}
+
+void Postoffice::Finalize() {
+  if (!van_) return;
+  if (shutting_down_.load()) {
+    van_->Stop();
+  } else if (role_ == ROLE_WORKER) {
+    // Say goodbye, then wait for the scheduler's fleet-wide SHUTDOWN
+    // (long grace period: other workers may still be training).
+    MsgHeader h{};
+    h.cmd = CMD_SHUTDOWN;
+    h.sender = my_id_;
+    van_->Send(FdOf(kSchedulerId), h);
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait_for(lk, std::chrono::seconds(300),
+                 [this] { return shutting_down_.load(); });
+    lk.unlock();
+    van_->Stop();
+  } else if (role_ == ROLE_SCHEDULER) {
+    // Wait for all workers' goodbyes (handled in ControlHandler).
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait_for(lk, std::chrono::seconds(30),
+                 [this] { return shutting_down_.load(); });
+    lk.unlock();
+    van_->Stop();
+  } else {
+    // Server: wait for SHUTDOWN broadcast.
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait_for(lk, std::chrono::seconds(30),
+                 [this] { return shutting_down_.load(); });
+    lk.unlock();
+    van_->Stop();
+  }
+  if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
+  if (monitor_thread_.joinable()) monitor_thread_.join();
+}
+
+}  // namespace bps

@@ -1,0 +1,112 @@
+// Node registry, id assignment, barriers, heartbeats.
+//
+// Capability parity: reference ps-lite Postoffice (SURVEY.md §2.4):
+// scheduler/server/worker role management, node registration handshake,
+// group barriers, env-driven addressing (DMLC_PS_ROOT_URI/PORT,
+// DMLC_NUM_WORKER, DMLC_NUM_SERVER), heartbeat-based failure detection
+// (PS_HEARTBEAT_INTERVAL / PS_HEARTBEAT_TIMEOUT).
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <functional>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "van.h"
+
+namespace bps {
+
+// Barrier groups (bitmask)
+enum BarrierGroup : int {
+  GROUP_SERVERS = 1,
+  GROUP_WORKERS = 2,
+  GROUP_ALL = 3,
+};
+
+class Postoffice {
+ public:
+  // App-level handler for data-plane messages (PUSH/PULL/...); control-plane
+  // (register/barrier/heartbeat) is consumed internally.
+  using AppHandler = std::function<void(Message&&, int fd)>;
+
+  Postoffice() = default;
+  ~Postoffice() { Finalize(); }
+
+  // Start the node: scheduler binds the root port and waits for everyone;
+  // servers/workers register with the scheduler and receive the address
+  // book; workers additionally dial every server. Blocks until the topology
+  // is fully connected. Returns this node's assigned id.
+  int Start(Role role, const std::string& root_uri, int root_port,
+            int num_workers, int num_servers, AppHandler app_handler);
+
+  // Block until every member of `group` reached the barrier.
+  void Barrier(int group);
+
+  void Finalize();  // graceful: scheduler broadcasts SHUTDOWN
+
+  // Invoked (on a van thread) when a fleet-wide SHUTDOWN arrives at a
+  // non-scheduler node — lets the KV layer fail fast on in-flight work
+  // instead of hanging when a peer died (failure detection, SURVEY.md §5).
+  void SetShutdownCallback(std::function<void()> cb) {
+    shutdown_cb_ = std::move(cb);
+  }
+
+  // --- topology queries ---
+  int my_id() const { return my_id_; }
+  Role role() const { return role_; }
+  int num_workers() const { return num_workers_; }
+  int num_servers() const { return num_servers_; }
+  // node ids: scheduler 0, servers 1..S, workers S+1..S+W
+  static int ServerId(int s) { return 1 + s; }
+  int WorkerId(int w) const { return 1 + num_servers_ + w; }
+  int my_worker_rank() const { return my_id_ - 1 - num_servers_; }
+  // fd of the connection to a node (workers: scheduler + all servers).
+  int FdOf(int node_id);
+
+  Van& van() { return *van_; }
+  bool ShuttingDown() const { return shutting_down_.load(); }
+  // Worker/server ids the scheduler considers dead (missed heartbeats).
+  std::vector<int> DeadNodes();
+
+ private:
+  void ControlHandler(Message&& msg, int fd);
+  void HeartbeatLoop();
+
+  std::unique_ptr<Van> van_;
+  AppHandler app_handler_;
+  Role role_ = ROLE_WORKER;
+  int my_id_ = -1;
+  int num_workers_ = 0;
+  int num_servers_ = 0;
+  std::atomic<bool> shutting_down_{false};
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::vector<NodeInfo> nodes_;            // address book (set by ADDRBOOK)
+  std::unordered_map<int, int> node_fd_;   // node id -> conn fd
+  bool addrbook_ready_ = false;
+
+  // scheduler state
+  struct PendingReg { int fd; NodeInfo info; };
+  std::vector<PendingReg> pending_regs_;
+  std::map<int, int> barrier_counts_;      // group -> count
+  std::unordered_map<int, int64_t> last_heartbeat_ms_;  // node id -> ts
+  int barrier_acks_needed_ = 0;
+
+  // client-side barrier wait state
+  std::map<int, int> barrier_done_;        // group -> generation
+
+  std::thread heartbeat_thread_;
+  std::thread monitor_thread_;  // scheduler: dead-node detection
+  std::function<void()> shutdown_cb_;
+};
+
+int64_t NowMs();
+
+}  // namespace bps

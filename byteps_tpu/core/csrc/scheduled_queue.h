@@ -1,0 +1,86 @@
+// Priority queue with credit-based admission.
+//
+// Capability parity: reference byteps/common/scheduled_queue.{h,cc}
+// (BytePSScheduledQueue): partitions are admitted to the DCN push stage
+// highest-priority-first (priority = negative declaration order, so
+// front-of-model gradients go first — the next forward pass needs them
+// first), with a credit cap on in-flight partitions
+// (BYTEPS_SCHEDULING_CREDIT) so one huge tensor cannot monopolise the
+// fabric. addTask/getTask/reportFinish → Push/Pop/ReleaseCredit.
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <vector>
+
+namespace bps {
+
+struct Task {
+  int priority = 0;       // higher = sooner
+  int64_t seq = 0;        // FIFO tie-break within a priority level
+  int64_t key = 0;
+  std::function<void()> run;
+};
+
+struct TaskOrder {
+  bool operator()(const Task& a, const Task& b) const {
+    if (a.priority != b.priority) return a.priority < b.priority;  // max-heap
+    return a.seq > b.seq;  // earlier enqueue first
+  }
+};
+
+class ScheduledQueue {
+ public:
+  explicit ScheduledQueue(int credit) : credits_(credit) {}
+
+  void Push(Task t) {
+    std::lock_guard<std::mutex> lk(mu_);
+    t.seq = seq_++;
+    heap_.push(std::move(t));
+    cv_.notify_one();
+  }
+
+  // Blocks until a task is available AND a credit is free (or Stop()).
+  bool Pop(Task* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [this] {
+      return stopped_ || (!heap_.empty() && credits_ > 0);
+    });
+    if (stopped_) return false;
+    *out = heap_.top();
+    heap_.pop();
+    credits_--;
+    return true;
+  }
+
+  // Called when a partition completes its pull (reference: reportFinish).
+  void ReleaseCredit() {
+    std::lock_guard<std::mutex> lk(mu_);
+    credits_++;
+    cv_.notify_one();
+  }
+
+  void Stop() {
+    std::lock_guard<std::mutex> lk(mu_);
+    stopped_ = true;
+    cv_.notify_all();
+  }
+
+  size_t pending() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return heap_.size();
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::priority_queue<Task, std::vector<Task>, TaskOrder> heap_;
+  int credits_;
+  int64_t seq_ = 0;
+  bool stopped_ = false;
+};
+
+}  // namespace bps

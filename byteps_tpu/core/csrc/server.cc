@@ -1,0 +1,237 @@
+#include "server.h"
+
+#include <cstring>
+
+#include "cpu_reducer.h"
+#include "logging.h"
+
+namespace bps {
+
+void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
+  po_ = po;
+  async_ = async_mode;
+  queues_.clear();
+  for (int i = 0; i < engine_threads; ++i) {
+    queues_.push_back(std::make_unique<EngineQueue>());
+  }
+  for (int i = 0; i < engine_threads; ++i) {
+    threads_.emplace_back([this, i] { EngineLoop(i); });
+  }
+  BPS_LOG(INFO) << "server started: engine_threads=" << engine_threads
+                << " async=" << async_;
+}
+
+void BytePSServer::Handle(Message&& msg, int fd) {
+  // Route by key so one key's operations are totally ordered on one thread.
+  size_t tid = static_cast<size_t>(msg.head.key) % queues_.size();
+  auto& eq = *queues_[tid];
+  {
+    std::lock_guard<std::mutex> lk(eq.mu);
+    eq.q.push_back(EngineTask{std::move(msg), fd});
+  }
+  eq.cv.notify_one();
+}
+
+void BytePSServer::EngineLoop(int tid) {
+  auto& eq = *queues_[tid];
+  while (true) {
+    EngineTask task;
+    {
+      std::unique_lock<std::mutex> lk(eq.mu);
+      eq.cv.wait(lk, [&] { return stopped_.load() || !eq.q.empty(); });
+      if (stopped_.load() && eq.q.empty()) return;
+      task = std::move(eq.q.front());
+      eq.q.pop_front();
+    }
+    Process(std::move(task.msg), task.fd);
+  }
+}
+
+BytePSServer::KeyStore* BytePSServer::GetStore(int64_t key) {
+  std::lock_guard<std::mutex> lk(store_mu_);
+  auto it = store_.find(key);
+  return it == store_.end() ? nullptr : it->second.get();
+}
+
+void BytePSServer::Process(Message&& msg, int fd) {
+  const MsgHeader& h = msg.head;
+  switch (h.cmd) {
+    case CMD_INIT_KEY: {
+      {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        auto& ks = store_[h.key];
+        if (!ks) {
+          ks = std::make_unique<KeyStore>();
+          ks->len = h.arg0;
+          ks->dtype = h.dtype;
+          ks->comp_config.assign(msg.payload.begin(), msg.payload.end());
+          if (!ks->comp_config.empty()) {
+            int64_t n = ks->len / static_cast<int64_t>(sizeof(float));
+            ks->compressor = CreateCompressor(ks->comp_config, n);
+            if (ks->compressor) ks->scratch.resize(n);
+          }
+        } else {
+          BPS_CHECK_EQ(ks->len, h.arg0) << "key re-declared with new length";
+        }
+      }
+      MsgHeader ack{};
+      ack.cmd = CMD_INIT_ACK;
+      ack.sender = po_->my_id();
+      ack.key = h.key;
+      ack.req_id = h.req_id;
+      po_->van().Send(fd, ack);
+      break;
+    }
+
+    case CMD_PUSH: {
+      KeyStore* ks = GetStore(h.key);
+      BPS_CHECK(ks) << "push for undeclared key " << h.key;
+      const char* data = msg.payload.data();
+      int64_t data_len = static_cast<int64_t>(msg.payload.size());
+      // Decompress (compressed pushes are always float32 streams).
+      if (h.flags & FLAG_COMPRESSED) {
+        BPS_CHECK(ks->compressor) << "compressed push but no compressor for "
+                                  << h.key;
+        int64_t n = ks->len / static_cast<int64_t>(sizeof(float));
+        ks->compressor->Decompress(data, data_len, ks->scratch.data(), n);
+        data = reinterpret_cast<const char*>(ks->scratch.data());
+        data_len = ks->len;
+      }
+      BPS_CHECK_EQ(data_len, ks->len) << "push length mismatch for " << h.key;
+
+      if (async_ || (h.flags & FLAG_ASYNC)) {
+        // Async: server-resident accumulator; apply now, reply now.
+        if (!ks->param_init) {
+          ks->param.assign(data, data + data_len);
+          ks->param_init = true;
+        } else {
+          CpuReducer::Sum(ks->param.data(), data, data_len, ks->dtype);
+        }
+      } else {
+        int slot = h.version & 1;
+        BPS_CHECK(!ks->ready[slot])
+            << "push into a round still being pulled (key " << h.key << ")";
+        if (ks->push_count[slot] == 0) {
+          ks->slot[slot].assign(data, data + data_len);
+        } else {
+          CpuReducer::Sum(ks->slot[slot].data(), data, data_len, ks->dtype);
+        }
+        if (++ks->push_count[slot] == po_->num_workers()) {
+          ks->ready[slot] = true;
+          ks->pull_count[slot] = 0;
+          // Release any pulls that arrived before the last push.
+          for (auto& p : ks->pending_pulls[slot]) {
+            ReplyPull(ks, slot, p.first, p.second);
+          }
+          ks->pending_pulls[slot].clear();
+        }
+      }
+      MsgHeader ack{};
+      ack.cmd = CMD_PUSH_ACK;
+      ack.sender = po_->my_id();
+      ack.key = h.key;
+      ack.req_id = h.req_id;
+      po_->van().Send(fd, ack);
+      break;
+    }
+
+    case CMD_PULL: {
+      KeyStore* ks = GetStore(h.key);
+      BPS_CHECK(ks) << "pull for undeclared key " << h.key;
+      if (async_ || (h.flags & FLAG_ASYNC)) {
+        MsgHeader resp{};
+        resp.cmd = CMD_PULL_RESP;
+        resp.sender = po_->my_id();
+        resp.key = h.key;
+        resp.req_id = h.req_id;
+        resp.dtype = ks->dtype;
+        BPS_CHECK(ks->param_init) << "async pull before any push " << h.key;
+        po_->van().Send(fd, resp, ks->param.data(), ks->param.size());
+      } else {
+        int slot = h.version & 1;
+        if (ks->ready[slot]) {
+          ReplyPull(ks, slot, fd, h);
+        } else {
+          ks->pending_pulls[slot].emplace_back(fd, h);
+        }
+      }
+      break;
+    }
+
+    case CMD_BCAST_PUSH: {
+      KeyStore* ks = GetStore(h.key);
+      BPS_CHECK(ks) << "bcast_push for undeclared key " << h.key;
+      ks->param.assign(msg.payload.begin(), msg.payload.end());
+      ks->param_init = true;
+      MsgHeader ack{};
+      ack.cmd = CMD_PUSH_ACK;
+      ack.sender = po_->my_id();
+      ack.key = h.key;
+      ack.req_id = h.req_id;
+      po_->van().Send(fd, ack);
+      for (auto& p : ks->pending_bcast_pulls) {
+        ReplyBcastPull(ks, p.first, p.second);
+      }
+      ks->pending_bcast_pulls.clear();
+      break;
+    }
+
+    case CMD_BCAST_PULL: {
+      KeyStore* ks = GetStore(h.key);
+      BPS_CHECK(ks) << "bcast_pull for undeclared key " << h.key;
+      if (ks->param_init) {
+        ReplyBcastPull(ks, fd, h);
+      } else {
+        ks->pending_bcast_pulls.emplace_back(fd, h);
+      }
+      break;
+    }
+
+    default:
+      BPS_LOG(WARNING) << "server: unexpected cmd " << h.cmd;
+  }
+}
+
+void BytePSServer::ReplyPull(KeyStore* ks, int slot, int fd,
+                             const MsgHeader& req) {
+  MsgHeader resp{};
+  resp.cmd = CMD_PULL_RESP;
+  resp.sender = po_->my_id();
+  resp.key = req.key;
+  resp.req_id = req.req_id;
+  resp.dtype = ks->dtype;
+  resp.version = req.version;
+  po_->van().Send(fd, resp, ks->slot[slot].data(), ks->slot[slot].size());
+  if (++ks->pull_count[slot] == po_->num_workers()) {
+    // Round fully served; recycle the slot for round r+2.
+    ks->push_count[slot] = 0;
+    ks->pull_count[slot] = 0;
+    ks->ready[slot] = false;
+  }
+}
+
+void BytePSServer::ReplyBcastPull(KeyStore* ks, int fd, const MsgHeader& req) {
+  MsgHeader resp{};
+  resp.cmd = CMD_PULL_RESP;
+  resp.sender = po_->my_id();
+  resp.key = req.key;
+  resp.req_id = req.req_id;
+  resp.dtype = ks->dtype;
+  po_->van().Send(fd, resp, ks->param.data(), ks->param.size());
+}
+
+void BytePSServer::Stop() {
+  if (queues_.empty()) return;
+  stopped_.store(true);
+  for (auto& eq : queues_) {
+    std::lock_guard<std::mutex> lk(eq->mu);
+    eq->cv.notify_all();
+  }
+  for (auto& t : threads_) {
+    if (t.joinable()) t.join();
+  }
+  threads_.clear();
+  queues_.clear();
+}
+
+}  // namespace bps

@@ -1,0 +1,84 @@
+// The CPU-summation parameter server.
+//
+// Capability parity: reference byteps/server/server.{h,cc} (SURVEY.md
+// §2.3): a KV request handler plus an engine thread pool
+// (BYTEPS_SERVER_ENGINE_THREAD, default 4) so summation never blocks the
+// network threads; per-key aggregation buffers; sync mode releases pulls
+// once all num_worker pushes for a key arrived; async mode
+// (BYTEPS_ENABLE_ASYNC) keeps server-resident parameters, applies pushes
+// immediately and replies immediately. Summation via CpuReducer.
+//
+// Fresh design notes: keys are routed to engine threads by hash, which
+// serialises all work for one key on one thread — per-key ordering without
+// per-key locks. Sync-mode rounds are double-buffered by version parity
+// (head.version), tolerating the legal one-round skew between workers.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "compressor.h"
+#include "postoffice.h"
+
+namespace bps {
+
+class BytePSServer {
+ public:
+  void Start(Postoffice* po, int engine_threads, bool async_mode);
+  void Handle(Message&& msg, int fd);  // van-thread entry; enqueues to engine
+  void Stop();
+  ~BytePSServer() { Stop(); }
+
+ private:
+  struct KeyStore {
+    int64_t len = 0;  // decompressed payload bytes
+    int32_t dtype = BPS_FLOAT32;
+    std::string comp_config;
+    std::unique_ptr<Compressor> compressor;  // for decompressing pushes
+    std::vector<float> scratch;              // decompression target
+    // sync mode: double-buffered rounds
+    std::vector<char> slot[2];
+    int push_count[2] = {0, 0};
+    int pull_count[2] = {0, 0};
+    bool ready[2] = {false, false};
+    std::vector<std::pair<int, MsgHeader>> pending_pulls[2];
+    // async mode + broadcast: server-resident value
+    std::vector<char> param;
+    bool param_init = false;
+    std::vector<std::pair<int, MsgHeader>> pending_bcast_pulls;
+  };
+
+  struct EngineTask {
+    Message msg;
+    int fd;
+  };
+
+  void EngineLoop(int tid);
+  void Process(Message&& msg, int fd);
+  KeyStore* GetStore(int64_t key);
+  void ReplyPull(KeyStore* ks, int slot, int fd, const MsgHeader& req);
+  void ReplyBcastPull(KeyStore* ks, int fd, const MsgHeader& req);
+
+  Postoffice* po_ = nullptr;
+  bool async_ = false;
+  std::mutex store_mu_;  // guards store_ map shape only
+  std::unordered_map<int64_t, std::unique_ptr<KeyStore>> store_;
+
+  struct EngineQueue {
+    std::mutex mu;
+    std::condition_variable cv;
+    std::deque<EngineTask> q;
+  };
+  std::vector<std::unique_ptr<EngineQueue>> queues_;
+  std::vector<std::thread> threads_;
+  std::atomic<bool> stopped_{false};
+};
+
+}  // namespace bps

@@ -1,0 +1,219 @@
+#include "van.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+
+#include "logging.h"
+
+namespace bps {
+
+static bool SendAll(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= n;
+  }
+  return true;
+}
+
+static bool RecvAll(int fd, void* buf, size_t len) {
+  char* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n == 0) return false;  // peer closed
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= n;
+  }
+  return true;
+}
+
+int Van::Listen(int port) {
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  BPS_CHECK_GE(lfd, 0) << "socket() failed: " << strerror(errno);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  BPS_CHECK_EQ(::bind(lfd, reinterpret_cast<sockaddr*>(&addr),
+                      sizeof(addr)), 0)
+      << "bind(" << port << ") failed: " << strerror(errno);
+  BPS_CHECK_EQ(::listen(lfd, 128), 0)
+      << "listen failed: " << strerror(errno);
+  socklen_t alen = sizeof(addr);
+  getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  listen_fd_.store(lfd);
+  int bound = ntohs(addr.sin_port);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    threads_.emplace_back([this] { AcceptLoop(); });
+  }
+  BPS_LOG(DEBUG) << "van listening on port " << bound;
+  return bound;
+}
+
+int Van::Connect(const std::string& host, int port) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  std::string port_s = std::to_string(port);
+  // Retry: the peer may not have bound its listener yet (startup races are
+  // normal — the reference's ps-lite retries its scheduler dial the same way).
+  for (int attempt = 0; attempt < 300; ++attempt) {
+    if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0) {
+      usleep(100 * 1000);
+      continue;
+    }
+    int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+      freeaddrinfo(res);
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      StartRecvThread(fd);
+      return fd;
+    }
+    if (fd >= 0) ::close(fd);
+    freeaddrinfo(res);
+    res = nullptr;
+    usleep(100 * 1000);
+  }
+  BPS_LOG(WARNING) << "van connect to " << host << ":" << port
+                   << " failed after retries";
+  return -1;
+}
+
+bool Van::Send(int fd, const MsgHeader& head, const void* payload,
+               int64_t payload_len) {
+  MsgHeader h = head;
+  h.payload_len = payload_len;
+  uint64_t total = sizeof(MsgHeader) + static_cast<uint64_t>(payload_len);
+  std::shared_ptr<std::mutex> smu;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = send_mu_.find(fd);
+    if (it == send_mu_.end()) return false;
+    smu = it->second;
+  }
+  std::lock_guard<std::mutex> lk(*smu);
+  iovec iov[3];
+  iov[0].iov_base = &total;
+  iov[0].iov_len = sizeof(total);
+  iov[1].iov_base = &h;
+  iov[1].iov_len = sizeof(h);
+  iov[2].iov_base = const_cast<void*>(payload);
+  iov[2].iov_len = static_cast<size_t>(payload_len);
+  int iovcnt = payload_len > 0 ? 3 : 2;
+  // writev for the common case; fall back to SendAll on partial writes.
+  size_t want = sizeof(total) + sizeof(h) + (payload_len > 0 ? payload_len : 0);
+  ssize_t n = ::writev(fd, iov, iovcnt);
+  if (n == static_cast<ssize_t>(want)) return true;
+  if (n < 0) return false;
+  // Partial write: finish byte-by-byte from where writev stopped.
+  size_t done = static_cast<size_t>(n);
+  const char* bufs[3] = {reinterpret_cast<const char*>(&total),
+                         reinterpret_cast<const char*>(&h),
+                         static_cast<const char*>(payload)};
+  size_t lens[3] = {sizeof(total), sizeof(h),
+                    static_cast<size_t>(payload_len > 0 ? payload_len : 0)};
+  for (int i = 0; i < 3; ++i) {
+    if (done >= lens[i]) {
+      done -= lens[i];
+      continue;
+    }
+    if (!SendAll(fd, bufs[i] + done, lens[i] - done)) return false;
+    done = 0;
+  }
+  return true;
+}
+
+void Van::StartRecvThread(int fd) {
+  std::lock_guard<std::mutex> lk(mu_);
+  send_mu_.emplace(fd, std::make_shared<std::mutex>());
+  threads_.emplace_back([this, fd] { RecvLoop(fd); });
+}
+
+void Van::AcceptLoop() {
+  while (!stop_.load()) {
+    sockaddr_in peer{};
+    socklen_t plen = sizeof(peer);
+    int lfd = listen_fd_.load();
+    if (lfd < 0) return;
+    int fd = ::accept(lfd, reinterpret_cast<sockaddr*>(&peer), &plen);
+    if (fd < 0) {
+      if (stop_.load()) break;
+      if (errno == EINTR) continue;
+      break;  // listener shut down
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    StartRecvThread(fd);
+  }
+  // The accept thread owns the listening fd's close (Stop only shuts it
+  // down, so no other thread can race this close with a blocked accept).
+  int lfd = listen_fd_.exchange(-1);
+  if (lfd >= 0) ::close(lfd);
+}
+
+void Van::RecvLoop(int fd) {
+  while (!stop_.load()) {
+    uint64_t total = 0;
+    if (!RecvAll(fd, &total, sizeof(total))) break;
+    BPS_CHECK_GE(total, sizeof(MsgHeader)) << "malformed frame";
+    Message msg;
+    if (!RecvAll(fd, &msg.head, sizeof(MsgHeader))) break;
+    uint64_t plen = total - sizeof(MsgHeader);
+    BPS_CHECK_EQ(plen, static_cast<uint64_t>(msg.head.payload_len))
+        << "frame length mismatch";
+    if (plen > 0) {
+      msg.payload.resize(plen);
+      if (!RecvAll(fd, msg.payload.data(), plen)) break;
+    }
+    handler_(std::move(msg), fd);
+  }
+  CloseConn(fd);
+}
+
+// Connection fds are CLOSED only by their owning recv thread (via
+// CloseConn at RecvLoop exit); other threads may only shutdown() them.
+// This avoids the close-vs-blocked-recv fd-reuse race.
+void Van::CloseConn(int fd) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (send_mu_.erase(fd)) ::close(fd);
+}
+
+void Van::Stop() {
+  bool expected = false;
+  if (!stop_.compare_exchange_strong(expected, true)) return;
+  int lfd = listen_fd_.load();
+  if (lfd >= 0) ::shutdown(lfd, SHUT_RDWR);  // wakes accept; thread closes
+  std::vector<std::thread> ts;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : send_mu_) ::shutdown(kv.first, SHUT_RDWR);
+    ts.swap(threads_);
+  }
+  for (auto& t : ts) {
+    if (t.get_id() == std::this_thread::get_id()) t.detach();
+    else if (t.joinable()) t.join();
+  }
+}
+
+}  // namespace bps

@@ -1,0 +1,62 @@
+// Message transport over TCP sockets.
+//
+// Capability parity: reference ps-lite Van/ZMQVan (SURVEY.md §2.4) — node
+// handshake, framed message send/recv, zero-copy sends. Fresh design: no
+// ZMQ dependency; plain POSIX sockets with one receive thread per
+// connection (TPU-host fleets are Linux; thread-per-conn is simple and at
+// PS-scale [O(100) conns] well within epoll-free territory), writev-based
+// gather sends so payload bytes are never copied into a staging buffer.
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace bps {
+
+class Van {
+ public:
+  // Handler is invoked on the connection's receive thread. fd identifies the
+  // connection so upper layers can reply on the same socket.
+  using Handler = std::function<void(Message&&, int fd)>;
+
+  explicit Van(Handler handler) : handler_(std::move(handler)) {}
+  ~Van() { Stop(); }
+
+  // Bind + listen on port (0 = ephemeral). Returns the bound port.
+  int Listen(int port);
+
+  // Connect to a remote listener. Returns the connection fd (or -1).
+  int Connect(const std::string& host, int port);
+
+  // Send one framed message; thread-safe per connection. Payload bytes are
+  // written straight from `payload` (zero-copy gather write).
+  bool Send(int fd, const MsgHeader& head, const void* payload = nullptr,
+            int64_t payload_len = 0);
+
+  void CloseConn(int fd);
+  void Stop();
+  bool stopped() const { return stop_.load(); }
+
+ private:
+  void AcceptLoop();
+  void RecvLoop(int fd);
+  void StartRecvThread(int fd);
+
+  Handler handler_;
+  std::atomic<int> listen_fd_{-1};
+  std::atomic<bool> stop_{false};
+  std::mutex mu_;  // guards send_mu_ / threads_
+  // shared_ptr: Send() keeps the per-fd mutex alive across its write even
+  // if CloseConn erases the entry concurrently (connection teardown race).
+  std::unordered_map<int, std::shared_ptr<std::mutex>> send_mu_;
+  std::vector<std::thread> threads_;
+};
+
+}  // namespace bps

@@ -1,0 +1,265 @@
+#include "worker.h"
+
+#include <chrono>
+#include <cstring>
+
+#include "cpu_reducer.h"
+#include "logging.h"
+
+namespace bps {
+
+int64_t NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void BytePSWorker::Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
+                         int credit, std::string default_comp, bool trace_on) {
+  po_ = po;
+  kv_ = kv;
+  partition_bytes_ = partition_bytes;
+  default_comp_ = std::move(default_comp);
+  trace_on_ = trace_on;
+  queue_ = std::make_unique<ScheduledQueue>(credit);
+  push_thread_ = std::thread([this] { PushLoop(); });
+}
+
+void BytePSWorker::Stop() {
+  if (queue_) queue_->Stop();
+  if (push_thread_.joinable()) push_thread_.join();
+}
+
+void BytePSWorker::PushLoop() {
+  Task t;
+  while (queue_->Pop(&t)) t.run();
+}
+
+void BytePSWorker::Record(int64_t key, const char* stage, int64_t start_us) {
+  if (!trace_on_) return;
+  TraceEvent ev{};
+  ev.key = key;
+  snprintf(ev.stage, sizeof(ev.stage), "%s", stage);
+  ev.ts_us = start_us;
+  ev.dur_us = NowUs() - start_us;
+  std::lock_guard<std::mutex> lk(trace_mu_);
+  trace_.push_back(ev);
+}
+
+std::vector<TraceEvent> BytePSWorker::DrainTrace() {
+  std::lock_guard<std::mutex> lk(trace_mu_);
+  std::vector<TraceEvent> out;
+  out.swap(trace_);
+  return out;
+}
+
+int64_t BytePSWorker::Declare(const std::string& name, int64_t nelem,
+                              int dtype, const std::string& comp_config) {
+  std::unique_lock<std::mutex> lk(mu_);
+  auto it = by_name_.find(name);
+  if (it != by_name_.end()) {
+    auto& t = *tensors_[it->second];
+    BPS_CHECK_EQ(t.nelem, nelem) << "tensor " << name << " re-declared";
+    BPS_CHECK_EQ(t.dtype, dtype) << "tensor " << name << " re-declared";
+    return t.id;
+  }
+  auto ctx = std::make_unique<TensorCtx>();
+  ctx->id = static_cast<int64_t>(tensors_.size());
+  ctx->name = name;
+  ctx->nelem = nelem;
+  ctx->dtype = dtype;
+  ctx->priority = -static_cast<int>(ctx->id);  // declaration-order priority
+
+  const std::string& comp =
+      comp_config == "__default__" ? default_comp_ : comp_config;
+  if (!comp.empty()) {
+    BPS_CHECK_EQ(dtype, BPS_FLOAT32)
+        << "lossy compressors operate on float32 gradients";
+  }
+
+  int esz = DtypeSize(dtype);
+  int64_t per_part = std::max<int64_t>(1, partition_bytes_ / esz);
+  int64_t nparts = (nelem + per_part - 1) / per_part;
+  int ns = po_->num_servers();
+  for (int64_t i = 0; i < nparts; ++i) {
+    Part p;
+    p.key = (ctx->id << 16) | i;
+    p.server_id = Postoffice::ServerId(
+        static_cast<int>((ctx->id + i) % ns));
+    p.offset = i * per_part;
+    p.len = std::min(per_part, nelem - p.offset);
+    if (!comp.empty()) {
+      p.comp = CreateCompressor(comp, p.len);
+    }
+    ctx->parts.push_back(std::move(p));
+  }
+
+  // Register every partition with its owning server (blocking, but only
+  // on our own INIT_KEY requests — not on unrelated in-flight traffic).
+  std::vector<int> reqs;
+  for (auto& p : ctx->parts) {
+    MsgHeader h{};
+    h.cmd = CMD_INIT_KEY;
+    h.key = p.key;
+    h.dtype = dtype;
+    h.arg0 = p.len * esz;
+    reqs.push_back(kv_->Request(p.server_id, h, comp.data(),
+                                static_cast<int64_t>(comp.size()), nullptr));
+  }
+  int64_t id = ctx->id;
+  by_name_[name] = id;
+  tensors_.push_back(std::move(ctx));
+  lk.unlock();
+  kv_->WaitRequests(reqs);
+  return id;
+}
+
+int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
+                           int dtype, bool average, bool async_mode) {
+  std::unique_lock<std::mutex> lk(mu_);
+  BPS_CHECK_GE(tensor_id, 0);
+  BPS_CHECK(tensor_id < static_cast<int64_t>(tensors_.size()))
+      << "undeclared tensor id " << tensor_id;
+  TensorCtx* ctx = tensors_[tensor_id].get();
+  BPS_CHECK_EQ(ctx->nelem, nelem) << "shape changed for " << ctx->name;
+  BPS_CHECK_EQ(ctx->dtype, dtype) << "dtype changed for " << ctx->name;
+  int version = static_cast<int>(ctx->round & 1);
+  ctx->round++;
+  int handle_id = next_handle_++;
+  auto handle = std::make_shared<Handle>(static_cast<int>(ctx->parts.size()));
+  handles_[handle_id] = handle;
+  lk.unlock();
+
+  int esz = DtypeSize(dtype);
+  double scale = average ? 1.0 / po_->num_workers() : 1.0;
+  for (auto& part : ctx->parts) {
+    Part* p = &part;
+    Task task;
+    task.priority = ctx->priority;
+    task.key = p->key;
+    task.run = [this, ctx, p, ptr, esz, version, scale, async_mode, handle] {
+      char* base = static_cast<char*>(ptr) + p->offset * esz;
+      int64_t raw_len = p->len * esz;
+      const void* payload = base;
+      int64_t payload_len = raw_len;
+      int flags = async_mode ? FLAG_ASYNC : 0;
+      int64_t t0 = NowUs();
+      if (p->comp) {
+        p->comp->Compress(reinterpret_cast<const float*>(base), p->len,
+                          &p->comp_buf);
+        payload = p->comp_buf.data();
+        payload_len = static_cast<int64_t>(p->comp_buf.size());
+        flags |= FLAG_COMPRESSED;
+        Record(p->key, "compress", t0);
+      }
+      MsgHeader h{};
+      h.cmd = CMD_PUSH;
+      h.key = p->key;
+      h.dtype = ctx->dtype;
+      h.version = version;
+      h.flags = flags;
+      h.arg0 = raw_len;
+      int64_t t_push = NowUs();
+      kv_->Request(
+          p->server_id, h, payload, payload_len,
+          [this, ctx, p, base, raw_len, version, scale, flags, handle,
+           t_push](Message&&) {
+            Record(p->key, "push", t_push);
+            // Push acknowledged -> issue the pull for the aggregate.
+            MsgHeader ph{};
+            ph.cmd = CMD_PULL;
+            ph.key = p->key;
+            ph.dtype = ctx->dtype;
+            ph.version = version;
+            ph.flags = flags & FLAG_ASYNC;
+            int64_t t_pull = NowUs();
+            kv_->Request(
+                p->server_id, ph, nullptr, 0,
+                [this, ctx, p, base, raw_len, scale, handle,
+                 t_pull](Message&& resp) {
+                  Record(p->key, "pull", t_pull);
+                  BPS_CHECK_EQ(
+                      static_cast<int64_t>(resp.payload.size()), raw_len)
+                      << "pull length mismatch for key " << p->key;
+                  memcpy(base, resp.payload.data(), raw_len);
+                  if (scale != 1.0) {
+                    CpuReducer::Scale(base, scale, raw_len, ctx->dtype);
+                  }
+                  queue_->ReleaseCredit();
+                  if (handle->remaining.fetch_sub(1) == 1) {
+                    std::lock_guard<std::mutex> lk2(mu_);
+                    cv_.notify_all();
+                  }
+                });
+          });
+    };
+    queue_->Push(std::move(task));
+  }
+  return handle_id;
+}
+
+int BytePSWorker::Broadcast(int64_t tensor_id, void* ptr, int64_t nelem,
+                            int dtype, int root_rank) {
+  std::unique_lock<std::mutex> lk(mu_);
+  BPS_CHECK(tensor_id >= 0 &&
+            tensor_id < static_cast<int64_t>(tensors_.size()));
+  TensorCtx* ctx = tensors_[tensor_id].get();
+  BPS_CHECK_EQ(ctx->nelem, nelem);
+  int handle_id = next_handle_++;
+  auto handle = std::make_shared<Handle>(static_cast<int>(ctx->parts.size()));
+  handles_[handle_id] = handle;
+  lk.unlock();
+
+  bool is_root = po_->my_worker_rank() == root_rank;
+  int esz = DtypeSize(dtype);
+  for (auto& part : ctx->parts) {
+    Part* p = &part;
+    char* base = static_cast<char*>(ptr) + p->offset * esz;
+    int64_t raw_len = p->len * esz;
+    MsgHeader h{};
+    h.cmd = is_root ? CMD_BCAST_PUSH : CMD_BCAST_PULL;
+    h.key = p->key;
+    h.dtype = dtype;
+    auto done = [this, base, raw_len, is_root, handle](Message&& resp) {
+      if (!is_root) {
+        BPS_CHECK_EQ(static_cast<int64_t>(resp.payload.size()), raw_len);
+        memcpy(base, resp.payload.data(), raw_len);
+      }
+      if (handle->remaining.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> lk2(mu_);
+        cv_.notify_all();
+      }
+    };
+    if (is_root) {
+      kv_->Request(p->server_id, h, base, raw_len, done);
+    } else {
+      kv_->Request(p->server_id, h, nullptr, 0, done);
+    }
+  }
+  return handle_id;
+}
+
+void BytePSWorker::Wait(int handle_id) {
+  std::shared_ptr<Handle> h;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = handles_.find(handle_id);
+    if (it == handles_.end()) return;  // already reaped
+    h = it->second;
+  }
+  std::unique_lock<std::mutex> lk(mu_);
+  cv_.wait(lk, [&] { return h->remaining.load() == 0; });
+  handles_.erase(handle_id);
+}
+
+bool BytePSWorker::Poll(int handle_id) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = handles_.find(handle_id);
+  if (it == handles_.end()) return true;
+  if (it->second->remaining.load() != 0) return false;
+  // Reap on completion so poll-only consumers don't leak handle entries.
+  handles_.erase(it);
+  return true;
+}
+
+}  // namespace bps

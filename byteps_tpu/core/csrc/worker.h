@@ -1,0 +1,118 @@
+// Worker-side partitioned push/pull pipeline.
+//
+// Capability parity: reference byteps/common/operations.cc (InitTensor /
+// EnqueueTensor) + the PUSH→PULL stages of core_loops.cc (SURVEY.md §2.1,
+// §3.3): tensors are split into BYTEPS_PARTITION_BYTES slices at declare
+// time; each push_pull enqueues every partition into the priority-credit
+// scheduled queue; a push thread drains it (compress → ZPush), push-acks
+// chain into ZPulls, and pull responses land back in the caller's buffer.
+// Completion is tracked per-handle (reference: handle_manager.cc).
+//
+// The D2H/H2D and NCCL stages of the reference pipeline do not exist here:
+// on TPU those are XLA's job (ICI reduce-scatter inside jit); this class
+// only runs the DCN leg, on host buffers handed over via dlpack/numpy.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "compressor.h"
+#include "kv.h"
+#include "postoffice.h"
+#include "scheduled_queue.h"
+
+namespace bps {
+
+// Chrome-trace event record (reference: BYTEPS_TRACE_* timeline, §5).
+struct TraceEvent {
+  int64_t key;
+  char stage[16];
+  int64_t ts_us;
+  int64_t dur_us;
+};
+
+class BytePSWorker {
+ public:
+  void Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
+             int credit, std::string default_comp, bool trace_on);
+  void Stop();
+  ~BytePSWorker() { Stop(); }
+
+  // Partition + register a tensor with its owning servers (blocking).
+  // Returns the tensor id. Priority = negative declaration order.
+  int64_t Declare(const std::string& name, int64_t nelem, int dtype,
+                  const std::string& comp_config);
+
+  // Enqueue all partitions; returns a completion handle immediately.
+  // The aggregate (sum over workers; divided by num_workers when `average`)
+  // is written back into `ptr` in place.
+  int PushPull(int64_t tensor_id, void* ptr, int64_t nelem, int dtype,
+               bool average, bool async_mode);
+
+  // Init-time weight sync: root's buffer becomes everyone's (in place).
+  int Broadcast(int64_t tensor_id, void* ptr, int64_t nelem, int dtype,
+                int root_rank);
+
+  void Wait(int handle);
+  bool Poll(int handle);
+
+  std::vector<TraceEvent> DrainTrace();
+
+ private:
+  struct Part {
+    int64_t key;
+    int server_id;  // postoffice node id
+    int64_t offset;  // elements
+    int64_t len;     // elements
+    std::unique_ptr<Compressor> comp;
+    std::vector<char> comp_buf;
+  };
+
+  struct TensorCtx {
+    int64_t id;
+    std::string name;
+    int64_t nelem;
+    int dtype;
+    int priority;
+    int64_t round = 0;
+    std::vector<Part> parts;
+  };
+
+  struct Handle {
+    std::atomic<int> remaining;
+    explicit Handle(int n) : remaining(n) {}
+  };
+
+  void PushLoop();
+  void Record(int64_t key, const char* stage, int64_t start_us);
+
+  Postoffice* po_ = nullptr;
+  KVWorker* kv_ = nullptr;
+  int64_t partition_bytes_ = 4096000;
+  std::string default_comp_;
+  bool trace_on_ = false;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::unordered_map<std::string, int64_t> by_name_;
+  std::vector<std::unique_ptr<TensorCtx>> tensors_;
+  std::unordered_map<int, std::shared_ptr<Handle>> handles_;
+  int next_handle_ = 0;
+
+  std::unique_ptr<ScheduledQueue> queue_;
+  std::thread push_thread_;
+
+  std::mutex trace_mu_;
+  std::vector<TraceEvent> trace_;
+};
+
+int64_t NowUs();
+
+}  // namespace bps

@@ -1,0 +1,185 @@
+"""ctypes bindings to libbyteps_core.so.
+
+Capability parity: the reference's BytePSBasics ctypes loader
+(byteps/common/__init__.py, SURVEY.md §2.5) plus the per-framework C glue.
+Role classes map onto the reference's process roles: Scheduler / Server
+block until fleet shutdown; Worker exposes declare / push_pull / wait /
+broadcast / barrier over host numpy buffers (zero-copy: the C side reads
+and writes the array's memory in place).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from byteps_tpu.config import Config
+
+_DTYPE_MAP = {
+    "float32": 0,
+    "float64": 1,
+    "float16": 2,
+    "bfloat16": 3,
+    "int32": 4,
+    "int64": 5,
+    "uint8": 6,
+    "int8": 7,
+}
+
+# Barrier groups (mirror csrc/postoffice.h)
+GROUP_SERVERS = 1
+GROUP_WORKERS = 2
+GROUP_ALL = 3
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def ensure_built(force: bool = False) -> str:
+    from byteps_tpu.core.build import build
+    return build(force=force, verbose=False)
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    # BPS_CORE_LIB overrides the library path (sanitizer builds, debugging).
+    path = os.environ.get("BPS_CORE_LIB") or ensure_built()
+    lib = ctypes.CDLL(path)
+    lib.bps_init.argtypes = [ctypes.c_int]
+    lib.bps_init.restype = ctypes.c_int
+    lib.bps_finalize.argtypes = []
+    lib.bps_my_id.restype = ctypes.c_int
+    lib.bps_worker_rank.restype = ctypes.c_int
+    lib.bps_num_workers.restype = ctypes.c_int
+    lib.bps_num_servers.restype = ctypes.c_int
+    lib.bps_barrier.argtypes = [ctypes.c_int]
+    lib.bps_declare.argtypes = [ctypes.c_char_p, ctypes.c_longlong,
+                                ctypes.c_int, ctypes.c_char_p]
+    lib.bps_declare.restype = ctypes.c_longlong
+    lib.bps_push_pull.argtypes = [ctypes.c_longlong, ctypes.c_void_p,
+                                  ctypes.c_longlong, ctypes.c_int,
+                                  ctypes.c_int, ctypes.c_int]
+    lib.bps_push_pull.restype = ctypes.c_int
+    lib.bps_broadcast.argtypes = [ctypes.c_longlong, ctypes.c_void_p,
+                                  ctypes.c_longlong, ctypes.c_int,
+                                  ctypes.c_int]
+    lib.bps_broadcast.restype = ctypes.c_int
+    lib.bps_wait.argtypes = [ctypes.c_int]
+    lib.bps_poll.argtypes = [ctypes.c_int]
+    lib.bps_poll.restype = ctypes.c_int
+    lib.bps_dump_trace.argtypes = [ctypes.c_char_p]
+    lib.bps_dump_trace.restype = ctypes.c_int
+    lib.bps_dead_nodes.argtypes = [ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    lib.bps_dead_nodes.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+def _apply_config_env(cfg: Optional[Config]) -> None:
+    """Project a Config back into the env the C core reads (the C side is
+    env-configured for parity with the reference)."""
+    if cfg is None:
+        return
+    os.environ["DMLC_PS_ROOT_URI"] = cfg.root_uri
+    os.environ["DMLC_PS_ROOT_PORT"] = str(cfg.root_port)
+    os.environ["DMLC_NUM_WORKER"] = str(cfg.num_worker)
+    os.environ["DMLC_NUM_SERVER"] = str(cfg.num_server)
+    os.environ["BYTEPS_PARTITION_BYTES"] = str(cfg.partition_bytes)
+    os.environ["BYTEPS_SCHEDULING_CREDIT"] = str(cfg.scheduling_credit)
+    os.environ["BYTEPS_SERVER_ENGINE_THREAD"] = str(cfg.server_engine_threads)
+    os.environ["BYTEPS_ENABLE_ASYNC"] = "1" if cfg.enable_async else "0"
+    if cfg.compressor:
+        os.environ["BYTEPS_COMPRESSOR"] = cfg.compressor
+    os.environ["BYTEPS_TRACE_ON"] = "1" if cfg.trace_on else "0"
+
+
+class _Node:
+    ROLE = -1
+
+    def __init__(self, cfg: Optional[Config] = None):
+        _apply_config_env(cfg)
+        self._lib = _load()
+        self.node_id = self._lib.bps_init(self.ROLE)
+        if self.node_id < 0:
+            raise RuntimeError("bps_init failed")
+        self._alive = True
+
+    @classmethod
+    def start(cls, cfg: Optional[Config] = None):
+        return cls(cfg)
+
+    def shutdown(self) -> None:
+        if self._alive:
+            self._lib.bps_finalize()
+            self._alive = False
+
+    # Scheduler/Server block here until the fleet shuts down.
+    run = shutdown
+
+
+class Scheduler(_Node):
+    ROLE = 0
+
+    def dead_nodes(self, max_nodes: int = 64) -> list:
+        buf = (ctypes.c_int * max_nodes)()
+        n = self._lib.bps_dead_nodes(buf, max_nodes)
+        return list(buf[:n])
+
+
+class Server(_Node):
+    ROLE = 1
+
+
+class Worker(_Node):
+    ROLE = 2
+
+    def worker_rank(self) -> int:
+        return self._lib.bps_worker_rank()
+
+    def num_workers(self) -> int:
+        return self._lib.bps_num_workers()
+
+    def barrier(self, group: int = GROUP_WORKERS) -> None:
+        """Block until every member of `group` arrives. Default is the
+        worker group: a GROUP_ALL barrier requires servers to call Barrier
+        too, which BytePS servers (request-driven) never do."""
+        self._lib.bps_barrier(group)
+
+    def declare(self, name: str, nelem: int, dtype,
+                compression: Optional[str] = None) -> int:
+        """Register a tensor (reference: byteps_declare_tensor).
+        ``compression`` is a config string ("type=onebit;ef=vanilla"), ""
+        to disable, or None to inherit the BYTEPS_COMPRESSOR default."""
+        dt = _DTYPE_MAP[np.dtype(dtype).name]
+        comp = None if compression is None else compression.encode()
+        return int(self._lib.bps_declare(name.encode(), nelem, dt, comp))
+
+    def push_pull(self, tensor_id: int, arr: np.ndarray,
+                  average: bool = True, async_mode: bool = False) -> int:
+        """Enqueue all partitions of `arr`; sums across workers IN PLACE.
+        Returns a handle for wait/poll. The array must stay alive and
+        unmodified until the handle completes."""
+        assert arr.flags["C_CONTIGUOUS"], "push_pull needs a contiguous array"
+        return int(self._lib.bps_push_pull(
+            tensor_id, arr.ctypes.data_as(ctypes.c_void_p), arr.size,
+            _DTYPE_MAP[arr.dtype.name], int(average), int(async_mode)))
+
+    def broadcast(self, tensor_id: int, arr: np.ndarray,
+                  root_rank: int = 0) -> int:
+        assert arr.flags["C_CONTIGUOUS"]
+        return int(self._lib.bps_broadcast(
+            tensor_id, arr.ctypes.data_as(ctypes.c_void_p), arr.size,
+            _DTYPE_MAP[arr.dtype.name], root_rank))
+
+    def wait(self, handle: int) -> None:
+        self._lib.bps_wait(handle)
+
+    def poll(self, handle: int) -> bool:
+        return bool(self._lib.bps_poll(handle))
+
+    def dump_trace(self, path: str) -> int:
+        return int(self._lib.bps_dump_trace(path.encode()))

@@ -162,14 +162,11 @@ def _axes():
     return ici, dcn
 
 
-def _dcn_reduce_fn():
-    """The slow-level reduction hook: None → XLA DCN psum (collective
-    mode); PS mode routes through the C++ KV client (core.ffi)."""
-    st = _st()
-    if st.ps_client is None:
-        return None
-    from byteps_tpu.core import ffi as _ffi
-    return _ffi.make_dcn_reduce_fn(st.ps_client, st.registry)
+# In-jit push_pull always reduces via XLA collectives over the mesh axes.
+# In PS mode the mesh is process-local (one BytePS worker per controller
+# process), so those collectives cover exactly the local chips; the
+# cross-host DCN level runs at the host boundary through the C++ KV client
+# (byteps_tpu.jax.ps.ps_push_pull / _make_ps_train_step).
 
 
 def _inside_spmd(axis: Optional[str]) -> bool:
@@ -203,8 +200,7 @@ def _per_device_push_pull(tree, average, compression):
     orig_dtypes = jax.tree_util.tree_map(lambda x: x.dtype, tree)
     tree = jax.tree_util.tree_map(compression.compress, tree)
     red = _h.tree_all_reduce(
-        tree, ici_axis=ici, dcn_axis=dcn, average=average,
-        dcn_reduce_fn=_dcn_reduce_fn())
+        tree, ici_axis=ici, dcn_axis=dcn, average=average)
     return jax.tree_util.tree_map(
         lambda x, d: compression.decompress(x, d), red, orig_dtypes)
 

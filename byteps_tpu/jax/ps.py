@@ -1,0 +1,85 @@
+"""JAX ↔ C++ parameter-server bridge (PS mode).
+
+This is the DCN leg of the hierarchy (SURVEY.md §3.3): gradients leave the
+chips ici-reduced (XLA collectives inside the jitted step), cross the host
+boundary once, and the C++ core partitions / compresses / priority-schedules
+/ pushes them over TCP to the CPU-summation servers, pulling the aggregate
+back into the same buffers. One BytePS worker per controller process; the
+reduction denominator factorises as (local chips via pmean) x (worker
+hosts via PS average).
+
+Reference analogues: byteps/torch/ops.py (push_pull on framework tensors)
+and the COPYD2H → PUSH → PULL → COPYH2D pipeline stages.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import byteps_tpu.jax as bps
+
+
+def ps_push_pull(tree, average: bool = True, prefix: str = "grad",
+                 async_mode: Optional[bool] = None):
+    """Sum (or average) a pytree across worker hosts via the CPU PS fleet.
+
+    Host-level call (use on the outputs of a jitted step). All leaves are
+    enqueued before any wait, so partitions from every tensor pipeline
+    through the priority-scheduled push queue together — large trees
+    overlap compression, network, and summation across partitions exactly
+    like the reference's per-partition scheduling.
+    """
+    st = bps._st()
+    client = st.ps_client
+    if client is None:
+        raise RuntimeError(
+            "PS mode is not active (init with BYTEPS_PS_MODE=ps / "
+            "DMLC_NUM_SERVER>0)")
+    if async_mode is None:
+        async_mode = st.config.enable_async
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    staged = []
+    for i, leaf in enumerate(leaves):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        tid = client.declare(f"{prefix}_{i}", arr.size, arr.dtype)
+        h = client.push_pull(tid, arr, average=average,
+                             async_mode=async_mode)
+        staged.append((h, arr, leaf))
+    out = []
+    for h, arr, leaf in staged:
+        client.wait(h)
+        out.append(jnp.asarray(arr).reshape(leaf.shape).astype(leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def ps_broadcast(tree, root_rank: int = 0, prefix: str = "param"):
+    """Init-time weight sync across worker hosts through the servers
+    (reference: broadcast_parameters, SURVEY.md §3.4)."""
+    st = bps._st()
+    client = st.ps_client
+    if client is None:
+        raise RuntimeError("PS mode is not active")
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    staged = []
+    for i, leaf in enumerate(leaves):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        tid = client.declare(f"{prefix}_{i}", arr.size, arr.dtype)
+        h = client.broadcast(tid, arr, root_rank=root_rank)
+        staged.append((h, arr, leaf))
+    out = []
+    for h, arr, leaf in staged:
+        client.wait(h)
+        out.append(jnp.asarray(arr).reshape(leaf.shape).astype(leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def ps_barrier() -> None:
+    """Fleet-wide worker barrier through the scheduler."""
+    st = bps._st()
+    if st.ps_client is None:
+        raise RuntimeError("PS mode is not active")
+    st.ps_client.barrier()

@@ -47,6 +47,10 @@ def make_train_step(
     axes = tuple(a for a in (cfg.dcn_axis, cfg.ici_axis)
                  if a in mesh.axis_names)
 
+    if cfg.use_ps:
+        return _make_ps_train_step(loss_fn, optimizer, mesh, axes, average,
+                                   compression, donate)
+
     @partial(_shard_map, mesh=mesh,
              in_specs=(P(), P(), P(axes)),
              out_specs=(P(), P(), P()),
@@ -62,6 +66,55 @@ def make_train_step(
 
     jit_kwargs = {"donate_argnums": (0, 1)} if donate else {}
     return jax.jit(_step, **jit_kwargs)
+
+
+def _make_ps_train_step(loss_fn, optimizer, mesh, axes, average, compression,
+                        donate):
+    """PS-mode step: local-chip level inside jit, cross-host DCN level
+    through the C++ KV client to the CPU parameter servers (SURVEY.md
+    §3.3's two-level pipeline with XLA playing NCCL and the core playing
+    ps-lite).
+
+    In PS mode the mesh is process-local (one BytePS worker per controller
+    process), so the in-jit reduction covers exactly this host's chips.
+    Semantics match the collective path: average=True gives the global mean
+    (local pmean, then PS average over equal-sized workers); average=False
+    gives the global sum (local psum, then PS sum). Wire compression is
+    applied inside jit before the host transfer (XLA fuses the cast) and
+    undone after the pull.
+    """
+    from byteps_tpu.jax.ps import ps_push_pull
+
+    @jax.jit
+    @partial(_shard_map, mesh=mesh, in_specs=(P(), P(axes)),
+             out_specs=(P(), P()), check_vma=False)
+    def grad_step(params, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        reduce = lax.pmean if average else lax.psum
+        for ax in axes:
+            grads = jax.tree_util.tree_map(
+                lambda g, a=ax: reduce(g, a), grads)
+            loss = lax.pmean(loss, ax)
+        grads = jax.tree_util.tree_map(compression.compress, grads)
+        return loss, grads
+
+    def apply_step(params, opt_state, grads):
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    apply_jit = jax.jit(apply_step,
+                        donate_argnums=(0, 1) if donate else ())
+
+    def step(params, opt_state, batch):
+        loss, grads = grad_step(params, batch)
+        dtypes = jax.tree_util.tree_map(lambda p: p.dtype, params)
+        grads = ps_push_pull(grads, average=average)
+        grads = jax.tree_util.tree_map(
+            lambda g, d: compression.decompress(g, d), grads, dtypes)
+        params, opt_state = apply_jit(params, opt_state, grads)
+        return params, opt_state, loss
+
+    return step
 
 
 def replicate(tree, mesh: Optional[Mesh] = None):

@@ -1,0 +1,11 @@
+"""Flax model zoo for examples and benchmarks.
+
+The reference ships its models inside example scripts (example/pytorch/
+benchmark_byteps.py uses torchvision ResNet-50, SURVEY.md §2.6); we ship
+TPU-first flax implementations of the benchmark families named in
+BASELINE.md: ResNet-50 (ImageNet), BERT-Large, GPT-2 345M, plus a small
+MLP used by the test suite.
+"""
+
+from byteps_tpu.models.mlp import MLP  # noqa: F401
+from byteps_tpu.models.resnet import ResNet, ResNet18, ResNet50  # noqa: F401

@@ -1,0 +1,26 @@
+"""Parameter-server / scheduler process entry.
+
+Capability parity: reference byteps/server/__init__.py (SURVEY.md §2.3) —
+there, ``import byteps.server`` blocks in the server loop as an import
+side-effect. We keep the same capability behind an explicit entry point
+(``python -m byteps_tpu.server``; role from DMLC_ROLE) — import
+side-effects that block are hostile to tooling, so main() is a function.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def main() -> None:
+    role = os.environ.get("DMLC_ROLE", "server").lower()
+    from byteps_tpu.core import Scheduler, Server
+    if role == "scheduler":
+        node = Scheduler.start()
+    elif role == "server":
+        node = Server.start()
+    else:
+        raise SystemExit(f"DMLC_ROLE must be scheduler|server, got {role!r}")
+    # Start() returns once the topology is up; shutdown() blocks until the
+    # scheduler broadcasts fleet shutdown (worker goodbyes all received).
+    node.shutdown()

@@ -1,0 +1,258 @@
+"""Worker-side assertions for the localhost PS topology tests.
+
+Runs as a standalone process (one per worker rank); mode selected via
+BPS_TEST_MODE. Exits non-zero on any failed assertion — the parent test
+reaps exit codes exactly like the reference's run_byteps_test.sh.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+from byteps_tpu.core import Worker
+from byteps_tpu.core.ffi import GROUP_WORKERS
+
+
+def main() -> int:
+    mode = os.environ.get("BPS_TEST_MODE", "basic")
+    if mode == "jax_train":
+        return jax_train_main()
+    w = Worker.start()
+    rank = w.worker_rank()
+    nw = w.num_workers()
+    rng = np.random.default_rng(1234)  # same stream on all workers
+
+    try:
+        if mode == "basic":
+            # sum over workers, several shapes/dtypes, repeated rounds
+            for rnd in range(3):
+                for shape, dtype in [((64,), "float32"), ((31, 7), "float32"),
+                                     ((128,), "float64"), ((16,), "int32")]:
+                    base = rng.standard_normal(shape)
+                    x0 = (base * (rank + 1 + rnd)).astype(dtype)
+                    expect = sum(
+                        (base * (r + 1 + rnd)).astype(dtype).astype("float64")
+                        for r in range(nw))
+                    name = f"t_{shape}_{dtype}"
+                    tid = w.declare(name, int(np.prod(shape)), dtype,
+                                    compression="")
+                    arr = np.ascontiguousarray(x0)
+                    h = w.push_pull(tid, arr, average=False)
+                    w.wait(h)
+                    np.testing.assert_allclose(
+                        arr.astype("float64"), expect.reshape(shape),
+                        rtol=1e-5, atol=1e-8)
+
+        elif mode == "average":
+            tid = w.declare("avg", 50, "float32", compression="")
+            arr = np.full(50, float(rank + 1), dtype=np.float32)
+            h = w.push_pull(tid, arr, average=True)
+            w.wait(h)
+            expect = sum(r + 1 for r in range(nw)) / nw
+            np.testing.assert_allclose(arr, expect, rtol=1e-6)
+
+        elif mode == "multipart":
+            # tensor >> partition_bytes so it spans partitions and servers
+            n = 300_000  # 1.2 MB f32; BYTEPS_PARTITION_BYTES set to 65536
+            tid = w.declare("big", n, "float32", compression="")
+            base = rng.standard_normal(n).astype(np.float32)
+            arr = np.ascontiguousarray(base * (rank + 1))
+            h = w.push_pull(tid, arr, average=False)
+            w.wait(h)
+            scale = sum(r + 1 for r in range(nw))
+            np.testing.assert_allclose(arr, base * scale, rtol=1e-4,
+                                       atol=1e-5)
+
+        elif mode == "broadcast":
+            tid = w.declare("bc", 1000, "float32", compression="")
+            if rank == 0:
+                arr = rng.standard_normal(1000).astype(np.float32)
+            else:
+                arr = np.zeros(1000, dtype=np.float32)
+            root_val = rng2 = None
+            h = w.broadcast(tid, arr, root_rank=0)
+            w.wait(h)
+            # all ranks must hold rank0's values: regenerate rank0's stream
+            check = np.random.default_rng(1234).standard_normal(1000).astype(
+                np.float32)
+            np.testing.assert_allclose(arr, check, rtol=1e-6)
+
+        elif mode == "handles":
+            # several in-flight handles; poll semantics
+            tids = [w.declare(f"h{i}", 4096, "float32", compression="")
+                    for i in range(8)]
+            arrs = [np.full(4096, float(i + rank), np.float32)
+                    for i in range(8)]
+            handles = [w.push_pull(t, a, average=False)
+                       for t, a in zip(tids, arrs)]
+            for h in handles:
+                w.wait(h)
+                assert w.poll(h)
+            for i, a in enumerate(arrs):
+                expect = sum(i + r for r in range(nw))
+                np.testing.assert_allclose(a, expect)
+
+        elif mode == "onebit":
+            # semantics vs a numpy reference of the codec (single worker):
+            # decompress(compress(x)) == sign(x) * mean(|x|)
+            x = rng.standard_normal(1000).astype(np.float32)
+            tid = w.declare("ob", 1000, "float32", compression="type=onebit")
+            arr = x.copy()
+            h = w.push_pull(tid, arr, average=False)
+            w.wait(h)
+            expect = np.where(x >= 0, 1.0, -1.0) * np.abs(x).mean()
+            np.testing.assert_allclose(arr, expect, rtol=1e-5, atol=1e-6)
+
+        elif mode == "topk_lossless":
+            # k = n makes topk exact; aggregation must then match plain sum
+            n = 256
+            base = rng.standard_normal(n).astype(np.float32)
+            x = base * (rank + 1)
+            tid = w.declare("tk", n, "float32", compression=f"type=topk;k={n}")
+            arr = x.copy()
+            h = w.push_pull(tid, arr, average=False)
+            w.wait(h)
+            scale = sum(r + 1 for r in range(nw))
+            np.testing.assert_allclose(arr, base * scale, rtol=1e-5,
+                                       atol=1e-5)
+
+        elif mode == "error_feedback":
+            # with ef, repeated rounds of a CONSTANT gradient must converge
+            # in mean: residual accumulation corrects the onebit bias.
+            n = 512
+            g = rng.standard_normal(n).astype(np.float32)
+            tid = w.declare("ef", n, "float32",
+                            compression="type=onebit;ef=vanilla")
+            total = np.zeros(n, dtype=np.float64)
+            rounds = 200
+            for _ in range(rounds):
+                arr = g.copy()
+                h = w.push_pull(tid, arr, average=True)
+                w.wait(h)
+                total += arr
+            mean_recv = total / rounds
+            err = np.abs(mean_recv - g).mean() / (np.abs(g).mean() + 1e-9)
+            assert err < 0.05, f"error feedback failed to converge: {err}"
+
+        elif mode == "async":
+            # async mode: server-resident accumulator, immediate replies
+            tid = w.declare("as", 16, "float32", compression="")
+            for step in range(1, 4):
+                arr = np.full(16, 1.0, dtype=np.float32)
+                h = w.push_pull(tid, arr, average=False, async_mode=True)
+                w.wait(h)
+            # after 3 pushes of ones (any interleaving), the pulled value is
+            # between my 3 pushes and nw*3 total pushes
+            assert arr[0] >= 3.0 - 1e-6 and arr[0] <= 3.0 * nw + 1e-6, arr[0]
+
+        elif mode == "trace":
+            tid = w.declare("tr", 1 << 16, "float32", compression="")
+            arr = np.ones(1 << 16, dtype=np.float32)
+            h = w.push_pull(tid, arr, average=False)
+            w.wait(h)
+            path = os.path.join(os.environ["BPS_TRACE_OUT"],
+                                f"trace_rank{rank}.json")
+            n = w.dump_trace(path)
+            assert n > 0, "no trace events recorded"
+            import json
+            with open(path) as f:
+                data = json.load(f)
+            stages = {e["name"] for e in data["traceEvents"]}
+            assert "push" in stages and "pull" in stages, stages
+
+        elif mode == "slow":
+            # long-running rounds; used by the failure-detection test
+            import time
+            tid = w.declare("slow", 1024, "float32", compression="")
+            for i in range(500):
+                arr = np.ones(1024, dtype=np.float32)
+                h = w.push_pull(tid, arr, average=False)
+                w.wait(h)
+                time.sleep(0.2)
+                if i % 10 == 0:
+                    print(f"step {i}", flush=True)
+
+        elif mode == "barrier":
+            w.barrier(GROUP_WORKERS)
+            print(f"rank {rank} passed barrier")
+
+        else:
+            raise SystemExit(f"unknown BPS_TEST_MODE {mode!r}")
+
+        print(f"worker {rank}: {mode} OK")
+        return 0
+    finally:
+        w.shutdown()
+
+
+def jax_train_main() -> int:
+    """End-to-end: PS-mode DP training across worker processes must match
+    single-process training on the combined batch (jax plugin owns the
+    BytePS worker; do not Worker.start() separately)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    import byteps_tpu.jax as bps_jax
+    from byteps_tpu.config import get_config
+    from byteps_tpu.jax.training import make_train_step
+
+    cfg = get_config(reload=True)
+    assert cfg.use_ps, "expected PS mode in jax_train"
+    bps_jax.init()
+    st = bps_jax._st()
+    assert st.ps_client is not None
+    rank = st.ps_client.worker_rank()
+    nw = st.ps_client.num_workers()
+
+    def loss_fn(params, batch):
+        x, y = batch
+        pred = jnp.tanh(x @ params["w1"]) @ params["w2"]
+        return jnp.mean((pred - y) ** 2)
+
+    prng = np.random.default_rng(5)
+    params0 = {
+        "w1": jnp.asarray(prng.standard_normal((6, 8)), jnp.float32) * 0.4,
+        "w2": jnp.asarray(prng.standard_normal((8, 3)), jnp.float32) * 0.4,
+    }
+    tx = optax.sgd(0.1)
+    step = make_train_step(loss_fn, tx)
+    params = jax.tree_util.tree_map(jnp.array, params0)
+    opt_state = tx.init(params)
+    per = 8  # rows per worker
+    for _ in range(6):
+        gx = prng.standard_normal((nw * per, 6)).astype(np.float32)
+        gy = gx[:, :3] * 2.0
+        lo, hi = rank * per, (rank + 1) * per
+        params, opt_state, loss = step(params, opt_state,
+                                       (gx[lo:hi], gy[lo:hi]))
+
+    # reference: replay the same stream, full global batch, one device
+    ref_prng = np.random.default_rng(5)
+    ref_prng.standard_normal((6, 8))
+    ref_prng.standard_normal((8, 3))
+
+    @jax.jit
+    def ref_step(p, s, batch):
+        _, g = jax.value_and_grad(loss_fn)(p, batch)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    ref_params = jax.tree_util.tree_map(jnp.array, params0)
+    ref_state = tx.init(ref_params)
+    for _ in range(6):
+        gx = ref_prng.standard_normal((nw * per, 6)).astype(np.float32)
+        gy = gx[:, :3] * 2.0
+        ref_params, ref_state = ref_step(ref_params, ref_state, (gx, gy))
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(params[k]), np.asarray(ref_params[k]),
+            rtol=2e-4, atol=2e-5)
+    bps_jax.shutdown()
+    print(f"worker {rank}: jax_train OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,129 @@
+"""C++ core integration tests: real localhost PS topology, no mocks.
+
+Covers the reference test matrix (SURVEY.md §4): push_pull numerics over
+shapes/dtypes/rounds, averaging, multi-partition multi-server tensors,
+broadcast from root, handle semantics, compression codecs + error
+feedback, async mode, trace timeline, barriers.
+"""
+
+import os
+
+import pytest
+
+from tests.ps_utils import run_topology
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_ps_worker.py")
+
+pytestmark = pytest.mark.ps  # slow-ish multiprocess tests
+
+
+def test_basic_sum_2workers_1server():
+    run_topology(2, 1, WORKER, mode="basic")
+
+
+def test_basic_sum_3workers_2servers():
+    run_topology(3, 2, WORKER, mode="basic")
+
+
+def test_average():
+    run_topology(2, 1, WORKER, mode="average")
+
+
+def test_multipartition_spans_servers():
+    run_topology(2, 3, WORKER, mode="multipart",
+                 extra={"BYTEPS_PARTITION_BYTES": "65536"})
+
+
+def test_broadcast_from_root():
+    run_topology(3, 2, WORKER, mode="broadcast")
+
+
+def test_multiple_inflight_handles():
+    run_topology(2, 2, WORKER, mode="handles",
+                 extra={"BYTEPS_SCHEDULING_CREDIT": "2"})
+
+
+def test_onebit_semantics():
+    run_topology(1, 1, WORKER, mode="onebit",
+                 extra={"BYTEPS_FORCE_DISTRIBUTED": "1"})
+
+
+def test_topk_lossless_aggregation():
+    run_topology(2, 1, WORKER, mode="topk_lossless")
+
+
+def test_error_feedback_converges():
+    run_topology(1, 1, WORKER, mode="error_feedback")
+
+
+def test_async_mode():
+    run_topology(2, 1, WORKER, mode="async",
+                 extra={"BYTEPS_ENABLE_ASYNC": "1"})
+
+
+def test_trace_timeline(tmp_path):
+    run_topology(1, 1, WORKER, mode="trace",
+                 extra={"BYTEPS_TRACE_ON": "1",
+                        "BPS_TRACE_OUT": str(tmp_path),
+                        "BYTEPS_PARTITION_BYTES": "65536"})
+
+
+def test_barrier():
+    run_topology(3, 1, WORKER, mode="barrier")
+
+
+def test_jax_ps_training_matches_single_process():
+    """The flagship e2e: 2 JAX worker processes training with the C++ PS
+    over localhost TCP reproduce single-process numerics exactly."""
+    run_topology(2, 1, WORKER, mode="jax_train",
+                 extra={"BYTEPS_PS_MODE": "ps"}, timeout=180)
+
+
+def test_failure_detection_dead_server():
+    """SURVEY.md §5 failure detection: killing a server mid-training must
+    fail-stop the fleet via scheduler heartbeat timeout — workers exit
+    with a diagnostic instead of hanging, scheduler exits cleanly."""
+    import subprocess
+    import time
+
+    from tests.ps_utils import free_port, spawn_role, spawn_worker, \
+        topology_env
+
+    port = free_port()
+    env = topology_env(2, 1, port, {"PS_HEARTBEAT_INTERVAL": "1",
+                                    "PS_HEARTBEAT_TIMEOUT": "3"})
+    sched = spawn_role("scheduler", env)
+    server = spawn_role("server", env)
+    workers = [spawn_worker(WORKER, env, r, "slow") for r in range(2)]
+    try:
+        # wait until both workers are mid-training
+        for p in workers:
+            for line in p.stdout:
+                if line.startswith("step 10"):
+                    break
+        server.kill()
+        t0 = time.time()
+        outs = []
+        for p in workers:
+            out, _ = p.communicate(timeout=30)
+            outs.append(out)
+            assert p.returncode != 0, "worker should fail-stop, not exit 0"
+        detect_s = time.time() - t0
+        assert detect_s < 25, f"failure detection too slow: {detect_s}s"
+        assert any("request(s) in flight" in o for o in outs), outs
+        sched.communicate(timeout=15)
+        assert sched.returncode == 0
+    finally:
+        for p in (sched, server, *workers):
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+
+def test_jax_ps_single_worker_force_distributed():
+    """Reference's BYTEPS_FORCE_DISTRIBUTED pattern: one worker still runs
+    the full PS path."""
+    run_topology(1, 1, WORKER, mode="jax_train",
+                 extra={"BYTEPS_PS_MODE": "ps",
+                        "BYTEPS_FORCE_DISTRIBUTED": "1"}, timeout=180)
