@@ -218,7 +218,11 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
     }
     case CMD_HEARTBEAT: {
       std::lock_guard<std::mutex> lk(mu_);
-      last_heartbeat_ms_[msg.head.sender] = NowMs();
+      // A cleanly-departed worker keeps heartbeating while it waits for
+      // the fleet shutdown; re-inserting it would later read as a death.
+      if (!departed_.count(msg.head.sender)) {
+        last_heartbeat_ms_[msg.head.sender] = NowMs();
+      }
       break;
     }
     case CMD_SHUTDOWN: {
@@ -227,6 +231,7 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
         std::lock_guard<std::mutex> lk(mu_);
         // A cleanly-departing node is not a failure: stop tracking it.
         last_heartbeat_ms_.erase(msg.head.sender);
+        departed_.insert(msg.head.sender);
         if (++barrier_counts_[-1] == num_workers_) {
           MsgHeader h{};
           h.cmd = CMD_SHUTDOWN;

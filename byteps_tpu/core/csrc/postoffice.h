@@ -15,6 +15,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "common.h"
@@ -97,6 +98,7 @@ class Postoffice {
   std::vector<PendingReg> pending_regs_;
   std::map<int, int> barrier_counts_;      // group -> count
   std::unordered_map<int, int64_t> last_heartbeat_ms_;  // node id -> ts
+  std::unordered_set<int> departed_;       // clean goodbyes: never "dead"
   int barrier_acks_needed_ = 0;
 
   // client-side barrier wait state

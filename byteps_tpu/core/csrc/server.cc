@@ -163,23 +163,29 @@ void BytePSServer::Process(Message&& msg, int fd) {
       BPS_CHECK(ks) << "bcast_push for undeclared key " << h.key;
       ks->param.assign(msg.payload.begin(), msg.payload.end());
       ks->param_init = true;
+      ks->bcast_version++;
       MsgHeader ack{};
       ack.cmd = CMD_PUSH_ACK;
       ack.sender = po_->my_id();
       ack.key = h.key;
       ack.req_id = h.req_id;
       po_->van().Send(fd, ack);
+      std::vector<std::pair<int, MsgHeader>> still_waiting;
       for (auto& p : ks->pending_bcast_pulls) {
-        ReplyBcastPull(ks, p.first, p.second);
+        if (ks->bcast_version > p.second.version) {
+          ReplyBcastPull(ks, p.first, p.second);
+        } else {
+          still_waiting.push_back(p);
+        }
       }
-      ks->pending_bcast_pulls.clear();
+      ks->pending_bcast_pulls.swap(still_waiting);
       break;
     }
 
     case CMD_BCAST_PULL: {
       KeyStore* ks = GetStore(h.key);
       BPS_CHECK(ks) << "bcast_pull for undeclared key " << h.key;
-      if (ks->param_init) {
+      if (ks->bcast_version > h.version) {
         ReplyBcastPull(ks, fd, h);
       } else {
         ks->pending_bcast_pulls.emplace_back(fd, h);

@@ -52,6 +52,10 @@ class BytePSServer {
     // async mode + broadcast: server-resident value
     std::vector<char> param;
     bool param_init = false;
+    // Count of broadcast rounds applied; a BCAST_PULL for round r
+    // (head.version == r) is served only once bcast_version > r, so a
+    // re-broadcast never hands out the previous round's bytes.
+    int bcast_version = 0;
     std::vector<std::pair<int, MsgHeader>> pending_bcast_pulls;
   };
 

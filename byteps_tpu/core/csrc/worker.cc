@@ -205,6 +205,10 @@ int BytePSWorker::Broadcast(int64_t tensor_id, void* ptr, int64_t nelem,
             tensor_id < static_cast<int64_t>(tensors_.size()));
   TensorCtx* ctx = tensors_[tensor_id].get();
   BPS_CHECK_EQ(ctx->nelem, nelem);
+  // All workers advance the round in lockstep (same call sequence), so a
+  // non-root's pull for round r waits for the root's r-th push even when
+  // the same tensor is re-broadcast later (weight re-sync).
+  int bcast_version = static_cast<int>(ctx->bcast_round++);
   int handle_id = next_handle_++;
   auto handle = std::make_shared<Handle>(static_cast<int>(ctx->parts.size()));
   handles_[handle_id] = handle;
@@ -220,6 +224,7 @@ int BytePSWorker::Broadcast(int64_t tensor_id, void* ptr, int64_t nelem,
     h.cmd = is_root ? CMD_BCAST_PUSH : CMD_BCAST_PULL;
     h.key = p->key;
     h.dtype = dtype;
+    h.version = bcast_version;
     auto done = [this, base, raw_len, is_root, handle](Message&& resp) {
       if (!is_root) {
         BPS_CHECK_EQ(static_cast<int64_t>(resp.payload.size()), raw_len);

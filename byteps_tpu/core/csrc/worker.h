@@ -82,6 +82,7 @@ class BytePSWorker {
     int dtype;
     int priority;
     int64_t round = 0;
+    int64_t bcast_round = 0;  // broadcast round (head.version on BCAST_*)
     std::vector<Part> parts;
   };
 

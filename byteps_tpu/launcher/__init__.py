@@ -1,0 +1,7 @@
+"""byteps_tpu.launcher — bpslaunch multi-role launcher.
+
+Reference analogue: launcher/launch.py (`bpslaunch` entry point),
+SURVEY.md §2.6.
+"""
+
+from byteps_tpu.launcher.launch import main  # noqa: F401

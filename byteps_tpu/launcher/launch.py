@@ -1,0 +1,243 @@
+"""bpslaunch — multi-role process launcher.
+
+Capability parity with the reference's ``launcher/launch.py`` (SURVEY.md
+§2.6): one CLI, behavior switched on ``DMLC_ROLE``:
+
+- ``scheduler`` / ``server`` → run the CPU parameter-server / scheduler
+  loop (reference: exec ``python -c 'import byteps.server'``).
+- ``worker`` → spawn worker process(es) running the user command with
+  ``BYTEPS_LOCAL_RANK`` / ``BYTEPS_LOCAL_SIZE`` set, and reap them.
+
+TPU-first differences from the reference:
+
+- The reference spawns ONE PROCESS PER GPU because NCCL+CUDA want
+  single-device processes. On TPU, one controller process drives all local
+  chips through XLA, so the default is one worker process per host
+  (``--workers-per-host 1``); the per-GPU fanout survives as
+  ``--workers-per-host N`` for CPU-simulation topologies.
+- ``--local N`` convenience mode brings up a full localhost fleet
+  (scheduler + servers + N workers) in one command — the reference needs
+  a shell script (tests/run_byteps_test.sh) for this.
+- NUMA pinning: ``--numa`` prefixes workers with ``numactl --cpunodebind``
+  round-robin, like the reference's numa wrapper.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import signal
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence
+
+
+def _role_env(base: Dict[str, str], role: str, **extra: str) -> Dict[str, str]:
+    env = dict(base)
+    env["DMLC_ROLE"] = role
+    env.update(extra)
+    return env
+
+
+def _numa_prefix(local_rank: int) -> List[str]:
+    """Round-robin NUMA binding (reference: launch.py numactl wrapper)."""
+    numactl = shutil.which("numactl")
+    if not numactl:
+        return []
+    try:
+        nodes = sorted(
+            int(d[4:]) for d in os.listdir("/sys/devices/system/node")
+            if d.startswith("node") and d[4:].isdigit())
+    except OSError:
+        return []
+    if len(nodes) <= 1:
+        return []
+    node = nodes[local_rank % len(nodes)]
+    return [numactl, f"--cpunodebind={node}", f"--membind={node}"]
+
+
+def run_server_role(role: str) -> int:
+    """Run the scheduler/server loop in-process; returns exit code."""
+    os.environ["DMLC_ROLE"] = role
+    from byteps_tpu.server import main as server_main
+    server_main()
+    return 0
+
+
+def spawn_workers(command: Sequence[str], workers_per_host: int,
+                  env: Dict[str, str], numa: bool = False
+                  ) -> List[subprocess.Popen]:
+    procs = []
+    for i in range(workers_per_host):
+        e = _role_env(env, "worker",
+                      BYTEPS_LOCAL_RANK=str(i),
+                      BYTEPS_LOCAL_SIZE=str(workers_per_host))
+        prefix = _numa_prefix(i) if numa else []
+        procs.append(subprocess.Popen(prefix + list(command), env=e))
+    return procs
+
+
+_TERM_GRACE_S = 10.0
+
+
+def _reap(procs: List[subprocess.Popen], names: Optional[List[str]] = None
+          ) -> int:
+    """Wait for all children; on first failure kill the rest.
+
+    Mirrors the reference launcher's fail-fast behavior: a dead worker
+    must take the job down, not hang it. Survivors get SIGTERM, then
+    SIGKILL after a grace period, so a child that traps SIGTERM (e.g. a
+    checkpoint-on-term training script) cannot wedge the launcher.
+    """
+    import time
+
+    names = names or [f"proc{i}" for i in range(len(procs))]
+    rc = 0
+    term_deadline = None
+    try:
+        remaining = dict(zip(names, procs))
+        while remaining:
+            if term_deadline is not None and time.monotonic() > term_deadline:
+                for q in remaining.values():
+                    q.kill()
+                term_deadline = None
+            for name in list(remaining):
+                p = remaining[name]
+                try:
+                    code = p.wait(timeout=0.2)
+                except subprocess.TimeoutExpired:
+                    continue
+                del remaining[name]
+                if code != 0:
+                    print(f"bpslaunch: {name} exited with {code}",
+                          file=sys.stderr)
+                    rc = rc or code
+                    if remaining and term_deadline is None:
+                        for q in remaining.values():
+                            q.terminate()
+                        term_deadline = time.monotonic() + _TERM_GRACE_S
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        deadline = time.monotonic() + _TERM_GRACE_S
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        rc = 130
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return rc
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local_fleet(command: Sequence[str], num_workers: int,
+                       num_servers: int, port: int, env: Dict[str, str],
+                       numa: bool = False) -> int:
+    """Bring up scheduler + servers + workers on 127.0.0.1 in one call
+    (the reference needs tests/run_byteps_test.sh for this topology).
+
+    port=0 picks a free port; because another process can grab it between
+    probe and bind, the scheduler launch is retried on fresh ports.
+    """
+    import time
+
+    base = dict(env)
+    base.update({
+        "DMLC_PS_ROOT_URI": base.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_NUM_SERVER": str(num_servers),
+        "BYTEPS_FORCE_DISTRIBUTED": "1",
+    })
+    server_cmd = [sys.executable, "-m", "byteps_tpu.server"]
+    auto_port = port == 0
+    for attempt in range(3):
+        chosen = _free_port() if auto_port else port
+        base["DMLC_PS_ROOT_PORT"] = str(chosen)
+        sched = subprocess.Popen(server_cmd, env=_role_env(base, "scheduler"))
+        # The scheduler binds immediately; if it lost the port race it dies
+        # within this window and we retry on a fresh port.
+        time.sleep(0.5)
+        if sched.poll() is None or sched.returncode == 0:
+            break
+        if not auto_port or attempt == 2:
+            print(f"bpslaunch: scheduler failed to start on port {chosen}",
+                  file=sys.stderr)
+            return sched.returncode or 1
+    procs = [sched]
+    names = ["scheduler"]
+    for s in range(num_servers):
+        procs.append(
+            subprocess.Popen(server_cmd, env=_role_env(base, "server")))
+        names.append(f"server{s}")
+    for w in range(num_workers):
+        e = _role_env(base, "worker",
+                      DMLC_WORKER_ID=str(w),
+                      BYTEPS_LOCAL_RANK="0",
+                      BYTEPS_LOCAL_SIZE="1")
+        prefix = _numa_prefix(w) if numa else []
+        procs.append(subprocess.Popen(prefix + list(command), env=e))
+        names.append(f"worker{w}")
+    return _reap(procs, names)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bpslaunch",
+        description="byteps_tpu multi-role launcher (role from DMLC_ROLE; "
+                    "see docs/env.md)")
+    p.add_argument("--local", type=int, metavar="N", default=0,
+                   help="localhost fleet mode: launch scheduler + servers + "
+                        "N workers on 127.0.0.1")
+    p.add_argument("--num-servers", type=int, default=1,
+                   help="servers for --local mode (default 1)")
+    p.add_argument("--port", type=int, default=0,
+                   help="scheduler port for --local mode (default: free port)")
+    p.add_argument("--workers-per-host", type=int,
+                   default=int(os.environ.get("BYTEPS_LOCAL_SIZE", "1") or 1),
+                   help="worker processes to spawn on this host (TPU default "
+                        "1: one controller drives all local chips)")
+    p.add_argument("--numa", action="store_true",
+                   help="bind worker processes round-robin across NUMA nodes")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="worker command, e.g. python train.py")
+    args = p.parse_args(argv)
+    command = list(args.command)
+    if command and command[0] == "--":
+        command = command[1:]
+
+    if args.local:
+        if not command:
+            p.error("--local requires a worker command")
+        return launch_local_fleet(command, args.local, args.num_servers,
+                                  args.port, dict(os.environ), numa=args.numa)
+
+    role = os.environ.get("DMLC_ROLE", "worker").lower()
+    if role in ("scheduler", "server"):
+        return run_server_role(role)
+    if role != "worker":
+        p.error(f"DMLC_ROLE must be scheduler|server|worker, got {role!r}")
+    if not command:
+        p.error("worker role requires a command")
+    procs = spawn_workers(command, args.workers_per_host, dict(os.environ),
+                          numa=args.numa)
+    return _reap(procs, [f"worker/{i}" for i in range(len(procs))])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

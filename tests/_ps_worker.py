@@ -78,6 +78,21 @@ def main() -> int:
                 np.float32)
             np.testing.assert_allclose(arr, check, rtol=1e-6)
 
+        elif mode == "rebroadcast":
+            # Re-broadcasting the same tensor (epoch-boundary weight
+            # re-sync) must deliver the NEW root values every round, never
+            # a stale previous round (server bcast_version ordering).
+            tid = w.declare("rb", 256, "float32", compression="")
+            for rnd in range(4):
+                if rank == 0:
+                    arr = np.full(256, float(100 + rnd), dtype=np.float32)
+                else:
+                    arr = np.zeros(256, dtype=np.float32)
+                h = w.broadcast(tid, arr, root_rank=0)
+                w.wait(h)
+                np.testing.assert_allclose(arr, 100.0 + rnd)
+                w.barrier(GROUP_WORKERS)
+
         elif mode == "handles":
             # several in-flight handles; poll semantics
             tids = [w.declare(f"h{i}", 4096, "float32", compression="")

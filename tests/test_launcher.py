@@ -1,0 +1,74 @@
+"""Launcher tests (reference: launcher/launch.py `bpslaunch`, SURVEY.md
+§2.6): role switching, worker spawn env, fail-fast reaping, and the
+--local full-fleet mode running a real PS topology end to end.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.ps_utils import REPO
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_ps_worker.py")
+
+
+def _env(**extra):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra)
+    return env
+
+
+def _bpslaunch(*args, env=None, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "byteps_tpu.launcher", *args],
+        env=env or _env(), capture_output=True, text=True, timeout=timeout)
+
+
+def test_worker_spawn_sets_local_rank_env():
+    code = ("import os; "
+            "assert os.environ['BYTEPS_LOCAL_RANK'] in ('0', '1'); "
+            "assert os.environ['BYTEPS_LOCAL_SIZE'] == '2'; "
+            "assert os.environ['DMLC_ROLE'] == 'worker'")
+    r = _bpslaunch("--workers-per-host", "2", "--",
+                   sys.executable, "-c", code,
+                   env=_env(DMLC_ROLE="worker"))
+    assert r.returncode == 0, r.stderr
+
+
+def test_worker_failure_propagates_exit_code():
+    r = _bpslaunch("--", sys.executable, "-c", "raise SystemExit(3)",
+                   env=_env(DMLC_ROLE="worker"))
+    assert r.returncode == 3
+
+
+def test_failed_worker_takes_down_siblings():
+    # one worker fails fast, the other would sleep forever: the launcher
+    # must kill it and return promptly with the failure code.
+    code = ("import os, time; "
+            "rank = int(os.environ['BYTEPS_LOCAL_RANK']); "
+            "time.sleep(3600) if rank else (_ for _ in ()).throw("
+            "SystemExit(7))")
+    r = _bpslaunch("--workers-per-host", "2", "--",
+                   sys.executable, "-c", code,
+                   env=_env(DMLC_ROLE="worker"), timeout=60)
+    assert r.returncode == 7
+
+
+def test_missing_command_errors():
+    r = _bpslaunch(env=_env(DMLC_ROLE="worker"))
+    assert r.returncode != 0
+
+
+@pytest.mark.ps
+def test_local_fleet_end_to_end():
+    """`bpslaunch --local 2` runs scheduler+server+2 workers doing real
+    push_pull numerics (the reference's run_byteps_test.sh topology as a
+    single CLI invocation)."""
+    r = _bpslaunch("--local", "2", "--num-servers", "1", "--",
+                   sys.executable, WORKER,
+                   env=_env(BPS_TEST_MODE="basic"))
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"

@@ -39,6 +39,10 @@ def test_broadcast_from_root():
     run_topology(3, 2, WORKER, mode="broadcast")
 
 
+def test_rebroadcast_delivers_fresh_values():
+    run_topology(3, 2, WORKER, mode="rebroadcast")
+
+
 def test_multiple_inflight_handles():
     run_topology(2, 2, WORKER, mode="handles",
                  extra={"BYTEPS_SCHEDULING_CREDIT": "2"})
