@@ -161,9 +161,16 @@ void BytePSServer::Process(Message&& msg, int fd) {
     case CMD_BCAST_PUSH: {
       KeyStore* ks = GetStore(h.key);
       BPS_CHECK(ks) << "bcast_push for undeclared key " << h.key;
+      int round = h.version;
+      // async pulls read ks->param; keep it tracking the latest round.
       ks->param.assign(msg.payload.begin(), msg.payload.end());
       ks->param_init = true;
-      ks->bcast_version++;
+      int waiters = po_->num_workers() - 1;
+      if (waiters > 0) {
+        auto& br = ks->bcast_rounds[round];
+        br.data.assign(msg.payload.begin(), msg.payload.end());
+        br.served = 0;
+      }
       MsgHeader ack{};
       ack.cmd = CMD_PUSH_ACK;
       ack.sender = po_->my_id();
@@ -172,8 +179,8 @@ void BytePSServer::Process(Message&& msg, int fd) {
       po_->van().Send(fd, ack);
       std::vector<std::pair<int, MsgHeader>> still_waiting;
       for (auto& p : ks->pending_bcast_pulls) {
-        if (ks->bcast_version > p.second.version) {
-          ReplyBcastPull(ks, p.first, p.second);
+        if (p.second.version == round) {
+          ServeBcastRound(ks, round, p.first, p.second);
         } else {
           still_waiting.push_back(p);
         }
@@ -185,8 +192,8 @@ void BytePSServer::Process(Message&& msg, int fd) {
     case CMD_BCAST_PULL: {
       KeyStore* ks = GetStore(h.key);
       BPS_CHECK(ks) << "bcast_pull for undeclared key " << h.key;
-      if (ks->bcast_version > h.version) {
-        ReplyBcastPull(ks, fd, h);
+      if (ks->bcast_rounds.count(h.version)) {
+        ServeBcastRound(ks, h.version, fd, h);
       } else {
         ks->pending_bcast_pulls.emplace_back(fd, h);
       }
@@ -224,6 +231,23 @@ void BytePSServer::ReplyBcastPull(KeyStore* ks, int fd, const MsgHeader& req) {
   resp.req_id = req.req_id;
   resp.dtype = ks->dtype;
   po_->van().Send(fd, resp, ks->param.data(), ks->param.size());
+}
+
+void BytePSServer::ServeBcastRound(KeyStore* ks, int round, int fd,
+                                   const MsgHeader& req) {
+  auto it = ks->bcast_rounds.find(round);
+  BPS_CHECK(it != ks->bcast_rounds.end());
+  MsgHeader resp{};
+  resp.cmd = CMD_PULL_RESP;
+  resp.sender = po_->my_id();
+  resp.key = req.key;
+  resp.req_id = req.req_id;
+  resp.dtype = ks->dtype;
+  resp.version = round;
+  po_->van().Send(fd, resp, it->second.data.data(), it->second.data.size());
+  if (++it->second.served >= po_->num_workers() - 1) {
+    ks->bcast_rounds.erase(it);
+  }
 }
 
 void BytePSServer::Stop() {

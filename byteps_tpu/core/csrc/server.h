@@ -49,13 +49,19 @@ class BytePSServer {
     int pull_count[2] = {0, 0};
     bool ready[2] = {false, false};
     std::vector<std::pair<int, MsgHeader>> pending_pulls[2];
-    // async mode + broadcast: server-resident value
+    // async mode: server-resident value
     std::vector<char> param;
     bool param_init = false;
-    // Count of broadcast rounds applied; a BCAST_PULL for round r
-    // (head.version == r) is served only once bcast_version > r, so a
-    // re-broadcast never hands out the previous round's bytes.
-    int bcast_version = 0;
+    // Broadcast: per-round buffers keyed by the root's round counter
+    // (head.version). A round-r BCAST_PULL is served exactly round r's
+    // bytes — never a previous or FUTURE round's, even when the root
+    // races ahead — and a round's buffer is freed once all num_workers-1
+    // non-root pulls for it were served.
+    struct BcastRound {
+      std::vector<char> data;
+      int served = 0;
+    };
+    std::unordered_map<int, BcastRound> bcast_rounds;
     std::vector<std::pair<int, MsgHeader>> pending_bcast_pulls;
   };
 
@@ -69,6 +75,8 @@ class BytePSServer {
   KeyStore* GetStore(int64_t key);
   void ReplyPull(KeyStore* ks, int slot, int fd, const MsgHeader& req);
   void ReplyBcastPull(KeyStore* ks, int fd, const MsgHeader& req);
+  void ServeBcastRound(KeyStore* ks, int round, int fd,
+                       const MsgHeader& req);
 
   Postoffice* po_ = nullptr;
   bool async_ = false;

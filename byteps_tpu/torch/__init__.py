@@ -1,0 +1,480 @@
+"""byteps_tpu.torch — PyTorch framework plugin (Horovod-compatible API).
+
+Capability parity with the reference's byteps/torch plugin (SURVEY.md §2.5
+and §3.3): ``init`` / ``shutdown`` / ``rank`` / ``size`` / ``local_rank`` /
+``local_size``, ``push_pull`` (+ ``_async`` / ``_inplace`` variants),
+``poll`` / ``synchronize`` / ``declare``, ``DistributedOptimizer`` with
+per-parameter gradient hooks (communication overlaps the remaining
+backward compute, reference: byteps/torch/__init__.py _make_hook),
+``broadcast_parameters`` and ``broadcast_optimizer_state``.
+
+Transport: the byteps_tpu C++ core (TCP van → CPU-summation parameter
+servers). CPU torch tensors share memory with numpy views, so the C side
+reads and writes the tensor's own buffer — the same zero-copy contract the
+reference gets from ZPush/ZPull over shared memory (byteps/torch/ops.cc
+DoPushPull → EnqueueTensor).
+
+Single-process mode (no scheduler configured): all collective calls degrade
+to local no-ops so scripts run unmodified, matching the reference's
+non-distributed fallback.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+from typing import Any, Iterator, Optional, Tuple
+
+import numpy as np
+import torch
+
+from byteps_tpu.config import Config, get_config
+from byteps_tpu.torch.compression import Compression
+
+__all__ = [
+    "init", "shutdown", "initialized", "rank", "size", "local_rank",
+    "local_size", "declare", "push_pull", "push_pull_async",
+    "push_pull_inplace_", "push_pull_async_inplace_", "poll", "synchronize",
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "DistributedOptimizer", "Compression",
+]
+
+_lock = threading.Lock()
+_client = None            # core.ffi.Worker in distributed mode
+_cfg: Optional[Config] = None
+_initialized = False
+_declared = {}            # name -> (tensor_id, nelem, dtype_name)
+
+# torch dtype -> numpy dtype accepted by the C core reducer.
+_TORCH_TO_NP = {
+    torch.float32: np.float32,
+    torch.float64: np.float64,
+    torch.float16: np.float16,
+    torch.int32: np.int32,
+    torch.int64: np.int64,
+    torch.uint8: np.uint8,
+    torch.int8: np.int8,
+}
+
+
+def init(config: Optional[Config] = None) -> None:
+    """Initialise the plugin (reference: bps.init() → byteps_init)."""
+    global _client, _cfg, _initialized
+    with _lock:
+        if _initialized:
+            return
+        _cfg = config or get_config(reload=True)
+        if _cfg.distributed:
+            from byteps_tpu.core import ffi as _ffi
+            _client = _ffi.Worker.start(_cfg)
+        _initialized = True
+
+
+def shutdown() -> None:
+    """Tear down (reference: byteps_shutdown)."""
+    global _client, _initialized, _noname_seq
+    with _lock:
+        if _client is not None:
+            _client.shutdown()
+            _client = None
+        _declared.clear()
+        _noname_seq = 0
+        _initialized = False
+
+
+def initialized() -> bool:
+    return _initialized
+
+
+def _require_init() -> None:
+    if not _initialized:
+        raise RuntimeError("byteps_tpu.torch.init() has not been called")
+
+
+def rank() -> int:
+    """This worker process's rank in [0, size())."""
+    _require_init()
+    return _client.worker_rank() if _client is not None else 0
+
+
+def size() -> int:
+    """Number of worker processes (the gradient-averaging denominator)."""
+    _require_init()
+    return _client.num_workers() if _client is not None else 1
+
+
+def local_rank() -> int:
+    _require_init()
+    return _cfg.local_rank
+
+
+def local_size() -> int:
+    _require_init()
+    return _cfg.local_size
+
+
+# --- tensor plumbing --------------------------------------------------------
+
+def _np_view(tensor: torch.Tensor) -> np.ndarray:
+    """Zero-copy flat numpy view over a contiguous CPU tensor's storage."""
+    if tensor.device.type != "cpu":
+        raise ValueError(
+            "byteps_tpu.torch drives CPU tensors; move to CPU first "
+            f"(got device {tensor.device})")
+    if tensor.dtype not in _TORCH_TO_NP:
+        raise ValueError(f"unsupported dtype {tensor.dtype}; cast to one of "
+                         f"{sorted(str(k) for k in _TORCH_TO_NP)}")
+    t = tensor.detach()
+    if not t.is_contiguous():
+        raise ValueError("in-place communication needs a contiguous tensor")
+    return t.view(-1).numpy()
+
+
+def declare(name: str, tensor: torch.Tensor,
+            compression_config: Optional[str] = None) -> int:
+    """Pre-register a tensor (reference: byteps_declare_tensor).
+    Declaration order fixes the communication priority: earlier-declared
+    tensors (front-of-model) are pushed first."""
+    _require_init()
+    if _client is None:
+        return -1
+    key = name
+    cached = _declared.get(key)
+    nelem = tensor.numel()
+    dt = np.dtype(_TORCH_TO_NP[tensor.dtype]).name
+    if cached is not None:
+        tid, n0, d0 = cached
+        if (n0, d0) != (nelem, dt):
+            raise ValueError(f"tensor {name!r} re-declared with different "
+                             f"shape/dtype ({n0},{d0}) vs ({nelem},{dt})")
+        return tid
+    tid = _client.declare(key, nelem, dt, compression=compression_config)
+    _declared[key] = (tid, nelem, dt)
+    return tid
+
+
+class Handle:
+    """An in-flight push_pull (reference: handle_manager.cc handles)."""
+
+    __slots__ = ("_core", "_wire", "_out", "_ctx", "_compression", "_done")
+
+    def __init__(self, core_handle, wire_tensor, out_tensor, ctx,
+                 compression):
+        self._core = core_handle
+        self._wire = wire_tensor
+        self._out = out_tensor
+        self._ctx = ctx
+        self._compression = compression
+        self._done = core_handle is None
+
+    def _finish(self) -> torch.Tensor:
+        if not self._done:
+            if self._core is not None and _client is not None:
+                _client.wait(self._core)
+            self._done = True
+            result = self._compression.decompress(self._wire, self._ctx)
+            if result.data_ptr() != self._out.data_ptr():
+                self._out.copy_(result.view_as(self._out))
+        return self._out
+
+
+_noname_seq = 0
+
+
+def _auto_name(tensor: torch.Tensor) -> str:
+    """Per-call sequential fallback name (reference/Horovod:
+    allreduce.noname.N). Correct because all ranks issue unnamed calls in
+    lockstep order; for tensors communicated repeatedly (training loops),
+    pass an explicit ``name`` so the key table stays bounded."""
+    global _noname_seq
+    name = f"byteps_tpu.noname.{_noname_seq}"
+    _noname_seq += 1
+    return name
+
+
+def push_pull_async_inplace_(tensor: torch.Tensor, average: bool = True,
+                             name: Optional[str] = None,
+                             compression=Compression.none) -> Handle:
+    """Start a push_pull that sums ``tensor`` across workers IN PLACE.
+    Returns a Handle for poll/synchronize. The hot path for gradients."""
+    _require_init()
+    if _client is None:
+        return Handle(None, tensor, tensor, None, Compression.none)
+    nm = name or _auto_name(tensor)
+    wire, ctx = compression.compress(tensor)
+    if wire.data_ptr() == tensor.data_ptr():
+        wire = tensor
+    wire = wire.contiguous()
+    tid = declare(nm, wire)
+    arr = _np_view(wire)
+    h = _client.push_pull(tid, arr, average=average,
+                          async_mode=_cfg.enable_async)
+    return Handle(h, wire, tensor, ctx, compression)
+
+
+def push_pull_async(tensor: torch.Tensor, average: bool = True,
+                    name: Optional[str] = None,
+                    compression=Compression.none) -> Handle:
+    """Like push_pull_async_inplace_ but leaves the input untouched and
+    resolves to a fresh result tensor."""
+    out = tensor.clone()
+    return push_pull_async_inplace_(out, average=average,
+                                    name=name or _auto_name(tensor),
+                                    compression=compression)
+
+
+def push_pull(tensor: torch.Tensor, average: bool = True,
+              name: Optional[str] = None,
+              compression=Compression.none) -> torch.Tensor:
+    """Blocking sum (or average) across all workers; returns the result
+    (input unchanged). Reference: byteps.torch.push_pull."""
+    return synchronize(push_pull_async(tensor, average=average, name=name,
+                                       compression=compression))
+
+
+def push_pull_inplace_(tensor: torch.Tensor, average: bool = True,
+                       name: Optional[str] = None,
+                       compression=Compression.none) -> torch.Tensor:
+    """Blocking in-place variant (reference: byteps.torch.push_pull_)."""
+    return synchronize(push_pull_async_inplace_(
+        tensor, average=average, name=name, compression=compression))
+
+
+def poll(handle: Handle) -> bool:
+    """True iff the handle's communication has completed (reference:
+    byteps_torch_poll)."""
+    if handle._done or handle._core is None or _client is None:
+        return True
+    return bool(_client.poll(handle._core))
+
+
+def synchronize(handle: Handle) -> torch.Tensor:
+    """Block until done; returns the reduced tensor."""
+    return handle._finish()
+
+
+# --- broadcast --------------------------------------------------------------
+
+def _named_tensors(params: Any) -> Iterator[Tuple[str, torch.Tensor]]:
+    if isinstance(params, dict):
+        yield from sorted(params.items())
+    else:
+        for i, item in enumerate(params):
+            if isinstance(item, tuple) and len(item) == 2:
+                yield item
+            else:
+                yield (str(i), item)
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0) -> None:
+    """Sync parameters from ``root_rank`` to all workers, in place
+    (reference: broadcast_parameters, SURVEY.md §3.4). ``params`` is a
+    state_dict or an iterable of (name, tensor) — e.g.
+    ``model.named_parameters()``."""
+    _require_init()
+    if _client is None:
+        return
+    handles = []
+    for name, t in _named_tensors(params):
+        if t is None or not isinstance(t, torch.Tensor):
+            continue
+        if not t.is_contiguous():
+            t.data = t.data.contiguous()
+        tid = declare(f"bcast.{name}", t)
+        arr = _np_view(t)
+        handles.append(_client.broadcast(tid, arr, root_rank=root_rank))
+    for h in handles:
+        _client.wait(h)
+
+
+def _pickle_bytes(obj: Any) -> bytes:
+    buf = io.BytesIO()
+    torch.save(obj, buf)
+    return buf.getvalue()
+
+
+def _broadcast_blob(name: str, payload: bytes, root_rank: int) -> bytes:
+    """Broadcast an arbitrary byte string from root (length first, then a
+    padded uint8 buffer) — used for non-tensor optimizer hyperparams, the
+    equivalent of the reference's scalar-wrapping in
+    broadcast_optimizer_state."""
+    ln = torch.tensor([len(payload)], dtype=torch.int64)
+    tid = _client.declare(f"blob_len.{name}", 1, "int64")
+    arr = _np_view(ln)
+    _client.wait(_client.broadcast(tid, arr, root_rank=root_rank))
+    n = int(ln.item())
+    buf = torch.zeros(n, dtype=torch.uint8)
+    if _client.worker_rank() == root_rank:
+        buf.copy_(torch.frombuffer(bytearray(payload), dtype=torch.uint8))
+    tid2 = _client.declare(f"blob.{name}.{n}", n, "uint8")
+    arr2 = _np_view(buf)
+    _client.wait(_client.broadcast(tid2, arr2, root_rank=root_rank))
+    return bytes(arr2.tobytes())
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Sync optimizer state from ``root_rank`` (reference:
+    broadcast_optimizer_state). Tensor state (momentum buffers, etc.) is
+    broadcast in place; scalar state and param_group hyperparameters travel
+    as a pickled blob."""
+    _require_init()
+    if _client is None:
+        return
+    # Materialize state on ranks that have not stepped yet (momentum
+    # buffers etc. only exist after the first step): a zero-gradient step
+    # creates them without changing parameters — the reference does the
+    # same before broadcasting.
+    if len(optimizer.state_dict()["state"]) == 0:
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p.grad is None:
+                    p.grad = torch.zeros_like(p.data)
+                elif p.grad is not None:
+                    p.grad.zero_()
+        optimizer.step()
+    state = optimizer.state_dict()
+    # Guard against per-rank state asymmetry (would otherwise deadlock in
+    # wait): every rank must hold the same (param-id, key) tensor set.
+    local_keys = sorted(
+        (str(pid), str(k), tuple(v.shape))
+        for pid in state["state"] for k, v in state["state"][pid].items()
+        if isinstance(v, torch.Tensor) and v.numel() > 0)
+    root_keys = torch.load(io.BytesIO(_broadcast_blob(
+        "opt_keys", _pickle_bytes(local_keys), root_rank)),
+        weights_only=False)
+    if local_keys != root_keys:
+        raise RuntimeError(
+            "broadcast_optimizer_state: optimizer state keys differ from "
+            f"root rank's ({len(local_keys)} local vs {len(root_keys)} "
+            "root entries); step all ranks the same number of times "
+            "before broadcasting")
+    # 1) tensors in .state, in deterministic (param-id, key) order
+    handles = []
+    scalars = {}
+    for pid in sorted(state["state"], key=str):
+        for k in sorted(state["state"][pid], key=str):
+            v = state["state"][pid][k]
+            if isinstance(v, torch.Tensor) and v.numel() > 0:
+                if not v.is_contiguous():
+                    state["state"][pid][k] = v = v.contiguous()
+                tid = declare(f"opt.{pid}.{k}", v)
+                handles.append(_client.broadcast(tid, _np_view(v),
+                                                 root_rank=root_rank))
+            else:
+                scalars[(str(pid), str(k))] = v
+    for h in handles:
+        _client.wait(h)
+    # 2) scalars + param_groups via pickled blob from root
+    blob = io.BytesIO()
+    torch.save({"scalars": scalars, "param_groups": state["param_groups"]},
+               blob)
+    data = _broadcast_blob("optimizer_state", blob.getvalue(), root_rank)
+    loaded = torch.load(io.BytesIO(data), weights_only=False)
+    for (pid, k), v in loaded["scalars"].items():
+        for real_pid in state["state"]:
+            if str(real_pid) == pid:
+                state["state"][real_pid][k] = v
+    state["param_groups"] = loaded["param_groups"]
+    optimizer.load_state_dict(state)
+
+
+# --- DistributedOptimizer ---------------------------------------------------
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Wraps a torch optimizer: per-parameter hooks launch push_pull the
+    moment each gradient is accumulated (overlapping communication with the
+    rest of backward), and ``step()`` waits for all of them before applying
+    updates. Reference: byteps/torch/__init__.py (_make_hook / step)."""
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self._bpps = backward_passes_per_step
+        self._handles = {}
+        self._grad_accs = []
+        self._passes = {}
+
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = [(f"param.{i}.{j}", p)
+                     for i, g in enumerate(self.param_groups)
+                     for j, p in enumerate(g["params"])]
+        if len({n for n, _ in named}) != len(named):
+            raise ValueError("DistributedOptimizer needs unique parameter "
+                             "names (pass model.named_parameters())")
+        self._param_names = {p: n for n, p in named}
+
+        if size() > 1:
+            self._register_hooks()
+
+    def _register_hooks(self) -> None:
+        if not hasattr(torch.Tensor, "register_post_accumulate_grad_hook"):
+            raise RuntimeError(
+                "byteps_tpu.torch.DistributedOptimizer needs torch >= 2.1 "
+                f"(register_post_accumulate_grad_hook); found "
+                f"{torch.__version__}")
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._passes[p] = 0
+                    p.register_post_accumulate_grad_hook(self._make_hook())
+
+    def _make_hook(self):
+        def hook(p: torch.Tensor) -> None:
+            if p in self._handles:
+                # The previous push_pull is still writing into p.grad;
+                # accumulating now would race with the comm thread.
+                raise RuntimeError(
+                    "Gradient for a parameter was computed more than "
+                    "backward_passes_per_step times without an optimizer "
+                    "step; raise backward_passes_per_step for gradient "
+                    "accumulation")
+            self._passes[p] += 1
+            if self._passes[p] < self._bpps:
+                return
+            self._passes[p] = 0
+            name = f"grad.{self._param_names.get(p, id(p))}"
+            if self._bpps > 1:
+                p.grad.div_(self._bpps)
+            self._handles[p] = push_pull_async_inplace_(
+                p.grad, average=True, name=name,
+                compression=self._compression)
+        return hook
+
+    def synchronize(self) -> None:
+        """Wait for every in-flight gradient push_pull."""
+        for p, h in list(self._handles.items()):
+            synchronize(h)
+        self._handles.clear()
+
+    def step(self, closure=None):
+        if size() > 1:
+            # Parameters whose hook never fired this step (e.g. frozen
+            # branches) simply have no handle; that matches the reference.
+            self.synchronize()
+        return super(self.__class__, self).step(closure)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1):
+    """Wrap ``optimizer`` for data-parallel training (reference API:
+    bps.DistributedOptimizer(optimizer, named_parameters=...,
+    compression=..., backward_passes_per_step=...)).
+
+    Returns an object of a dynamically created class inheriting from
+    ``optimizer``'s class with communication-aware ``step`` — the same
+    class-surgery contract as the reference, so isinstance checks and LR
+    schedulers keep working.
+    """
+    if backward_passes_per_step < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+    _require_init()
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step)

@@ -1,0 +1,46 @@
+"""Wire-level gradient compression for the torch plugin.
+
+Capability parity: reference byteps/torch/compression.py (SURVEY.md §2.5) —
+the Horovod-compatible ``Compression`` namespace: ``none`` and ``fp16``,
+applied to each tensor before communication and undone after.
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class NoneCompressor:
+    """No-op compression (reference: Compression.none)."""
+
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        return tensor
+
+
+class FP16Compressor:
+    """Cast to float16 for the wire, cast back after (reference:
+    Compression.fp16). Halves DCN bytes; the server sums in fp16."""
+
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        if tensor.dtype in (torch.float32, torch.float64):
+            return tensor.half(), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        if ctx is not None:
+            return tensor.to(ctx)
+        return tensor
+
+
+class Compression:
+    """Namespace of wire compressors (Horovod-compatible)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor

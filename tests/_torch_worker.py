@@ -1,0 +1,166 @@
+"""Worker-side assertions for the torch-plugin localhost topology tests.
+
+One process per worker rank, mode via BPS_TEST_MODE — the reference's
+tests/test_torch.py under run_byteps_test.sh pattern (SURVEY.md §4).
+"""
+
+import os
+import sys
+
+import numpy as np
+import torch
+
+import byteps_tpu.torch as bps
+
+
+def _train_model(seed: int = 0) -> torch.nn.Module:
+    torch.manual_seed(seed)
+    return torch.nn.Sequential(
+        torch.nn.Linear(6, 16), torch.nn.Tanh(), torch.nn.Linear(16, 3))
+
+
+def main() -> int:
+    mode = os.environ.get("BPS_TEST_MODE", "push_pull")
+    bps.init()
+    rank, nw = bps.rank(), bps.size()
+    rng = np.random.default_rng(1234)  # same stream on all workers
+
+    try:
+        if mode == "push_pull":
+            for shape, dtype in [((64,), torch.float32),
+                                 ((13, 5), torch.float32),
+                                 ((128,), torch.float64),
+                                 ((16,), torch.int64)]:
+                base = rng.standard_normal(shape)
+                x = torch.as_tensor(base * (rank + 1)).to(dtype)
+                x0 = x.clone()
+                out = bps.push_pull(x, average=False,
+                                    name=f"t_{shape}_{dtype}")
+                expect = sum(
+                    torch.as_tensor(base * (r + 1)).to(dtype).double()
+                    for r in range(nw))
+                torch.testing.assert_close(out.double(), expect,
+                                           rtol=1e-5, atol=1e-8)
+                # input unchanged by the non-inplace variant
+                torch.testing.assert_close(x, x0)
+
+            # in-place + average
+            y = torch.full((50,), float(rank + 1))
+            bps.push_pull_inplace_(y, average=True, name="avg")
+            expect = sum(r + 1 for r in range(nw)) / nw
+            torch.testing.assert_close(y, torch.full((50,), expect))
+
+            # async handles: several in flight, poll eventually true
+            handles = [bps.push_pull_async(
+                torch.full((1024,), float(i + rank)), average=False,
+                name=f"h{i}") for i in range(6)]
+            for i, h in enumerate(handles):
+                out = bps.synchronize(h)
+                assert bps.poll(h)
+                torch.testing.assert_close(
+                    out, torch.full((1024,), float(sum(i + r
+                                                       for r in range(nw)))))
+
+        elif mode == "fp16":
+            base = rng.standard_normal(512).astype(np.float32) * 0.1
+            x = torch.from_numpy(base * (rank + 1))
+            out = bps.push_pull(x, average=False, name="half",
+                                compression=bps.Compression.fp16)
+            scale = sum(r + 1 for r in range(nw))
+            assert out.dtype == torch.float32
+            torch.testing.assert_close(out, torch.from_numpy(base * scale),
+                                       rtol=2e-3, atol=2e-3)
+
+        elif mode == "broadcast":
+            model = _train_model(seed=rank)  # different init per rank
+            bps.broadcast_parameters(model.state_dict(), root_rank=0)
+            ref = _train_model(seed=0)
+            for (n1, p1), (_, p2) in zip(model.state_dict().items(),
+                                         ref.state_dict().items()):
+                torch.testing.assert_close(p1, p2)
+
+            # optimizer state: momentum buffers + lr from root
+            opt = torch.optim.SGD(model.parameters(),
+                                  lr=0.1 * (rank + 1), momentum=0.9)
+            x = torch.randn(4, 6, generator=torch.Generator().manual_seed(7))
+            loss = model(x).sum() * (rank + 1)  # different grads per rank
+            loss.backward()
+            opt.step()
+            bps.broadcast_optimizer_state(opt, root_rank=0)
+            assert abs(opt.param_groups[0]["lr"] - 0.1) < 1e-12, \
+                opt.param_groups[0]["lr"]
+            # momentum buffers now identical to rank0's: push_pull'ing each
+            # buffer (average) must be a fixed point
+            for pid, st in opt.state_dict()["state"].items():
+                buf = st["momentum_buffer"]
+                got = bps.push_pull(buf, average=True, name=f"chk.{pid}")
+                torch.testing.assert_close(got, buf, rtol=1e-6, atol=1e-7)
+
+        elif mode == "dist_opt":
+            # End-to-end: DP training with DistributedOptimizer must match
+            # single-process training on the combined batch.
+            model = _train_model(seed=3)
+            bps.broadcast_parameters(model.state_dict(), root_rank=0)
+            opt = torch.optim.SGD(model.parameters(), lr=0.05, momentum=0.9)
+            opt = bps.DistributedOptimizer(
+                opt, named_parameters=model.named_parameters())
+            assert isinstance(opt, torch.optim.SGD)
+
+            per = 8
+            data_rng = np.random.default_rng(42)
+            for _ in range(5):
+                gx = data_rng.standard_normal((nw * per, 6)).astype(np.float32)
+                gy = (gx[:, :3] * 2.0).astype(np.float32)
+                lo, hi = rank * per, (rank + 1) * per
+                x = torch.from_numpy(gx[lo:hi])
+                y = torch.from_numpy(gy[lo:hi])
+                opt.zero_grad()
+                loss = torch.nn.functional.mse_loss(model(x), y)
+                loss.backward()
+                opt.step()
+
+            # single-process replay of the same stream on the full batch
+            ref = _train_model(seed=3)
+            ref_opt = torch.optim.SGD(ref.parameters(), lr=0.05, momentum=0.9)
+            ref_rng = np.random.default_rng(42)
+            for _ in range(5):
+                gx = ref_rng.standard_normal((nw * per, 6)).astype(np.float32)
+                gy = (gx[:, :3] * 2.0).astype(np.float32)
+                ref_opt.zero_grad()
+                loss = torch.nn.functional.mse_loss(
+                    ref(torch.from_numpy(gx)), torch.from_numpy(gy))
+                loss.backward()
+                ref_opt.step()
+            for p1, p2 in zip(model.parameters(), ref.parameters()):
+                torch.testing.assert_close(p1, p2, rtol=2e-4, atol=2e-5)
+
+        elif mode == "grad_accum":
+            # backward_passes_per_step: communicate every 2nd backward
+            model = _train_model(seed=9)
+            bps.broadcast_parameters(model.state_dict(), root_rank=0)
+            opt = bps.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1),
+                named_parameters=model.named_parameters(),
+                backward_passes_per_step=2)
+            x = torch.randn(4, 6, generator=torch.Generator().manual_seed(1))
+            y = torch.zeros(4, 3)
+            for _ in range(2):  # two backward passes, one comm
+                loss = torch.nn.functional.mse_loss(model(x), y)
+                loss.backward()
+            opt.step()
+            # all ranks saw identical data → params must remain identical
+            for n, p in model.named_parameters():
+                got = bps.push_pull(p.data, average=True, name=f"fx.{n}")
+                torch.testing.assert_close(got, p.data, rtol=1e-6, atol=1e-7)
+
+        else:
+            raise SystemExit(f"unknown BPS_TEST_MODE {mode!r}")
+
+        print(f"worker {rank}: {mode} OK")
+        return 0
+    finally:
+        bps.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

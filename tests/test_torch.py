@@ -1,0 +1,93 @@
+"""torch-plugin tests over the real localhost PS topology.
+
+Reference analogue: tests/test_torch.py run under run_byteps_test.sh
+(SURVEY.md §4) — real scheduler + server + N single-device workers on
+127.0.0.1, numerics asserted inside the workers (tests/_torch_worker.py).
+"""
+
+import os
+
+import pytest
+
+from tests.ps_utils import run_topology
+
+WORKER = os.path.join(os.path.dirname(__file__), "_torch_worker.py")
+
+ps = pytest.mark.ps  # topology tests are slow; fast suite: -m "not ps"
+
+
+@ps
+def test_torch_push_pull():
+    run_topology(2, 1, WORKER, mode="push_pull")
+
+
+@ps
+def test_torch_push_pull_multiserver():
+    run_topology(2, 2, WORKER, mode="push_pull",
+                 extra={"BYTEPS_PARTITION_BYTES": "1024"})
+
+
+@ps
+def test_torch_fp16_compression():
+    run_topology(2, 1, WORKER, mode="fp16")
+
+
+@ps
+def test_torch_broadcast():
+    run_topology(2, 1, WORKER, mode="broadcast")
+
+
+@ps
+def test_torch_distributed_optimizer():
+    run_topology(2, 1, WORKER, mode="dist_opt")
+
+
+@ps
+def test_torch_distributed_optimizer_3workers():
+    run_topology(3, 2, WORKER, mode="dist_opt",
+                 extra={"BYTEPS_PARTITION_BYTES": "256"})
+
+
+@ps
+def test_torch_grad_accumulation():
+    run_topology(2, 1, WORKER, mode="grad_accum")
+
+
+def test_torch_single_process_fallback():
+    """No scheduler configured → every collective degrades to a local
+    no-op (reference: non-distributed mode)."""
+    import subprocess
+    import sys
+
+    code = """
+import torch
+import byteps_tpu.torch as bps
+from byteps_tpu.config import Config
+bps.init(Config(num_worker=1, num_server=0))
+assert bps.size() == 1 and bps.rank() == 0
+x = torch.ones(8)
+out = bps.push_pull(x, average=True)
+torch.testing.assert_close(out, x)
+h = bps.push_pull_async(x, average=False)
+assert bps.poll(h)
+torch.testing.assert_close(bps.synchronize(h), x)
+m = torch.nn.Linear(4, 2)
+bps.broadcast_parameters(m.state_dict(), root_rank=0)
+opt = bps.DistributedOptimizer(torch.optim.SGD(m.parameters(), lr=0.1),
+                               named_parameters=m.named_parameters())
+m(torch.randn(3, 4)).sum().backward()
+opt.step()
+bps.broadcast_optimizer_state(opt, root_rank=0)
+bps.shutdown()
+print("OK")
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
+    for var in ("DMLC_NUM_SERVER", "DMLC_NUM_WORKER", "DMLC_ROLE",
+                "BYTEPS_FORCE_DISTRIBUTED"):
+        env.pop(var, None)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OK" in out.stdout
