@@ -20,17 +20,7 @@ import json
 import time
 
 
-def main() -> None:
-    p = argparse.ArgumentParser()
-    p.add_argument("--batch", type=int, default=0, help="global batch "
-                   "(default: 64 per chip)")
-    p.add_argument("--steps", type=int, default=20)
-    p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--image-size", type=int, default=224)
-    p.add_argument("--smoke", action="store_true",
-                   help="tiny shapes for a fast correctness pass")
-    args = p.parse_args()
-
+def _maybe_force_cpu() -> None:
     import os
 
     import jax
@@ -38,6 +28,45 @@ def main() -> None:
         # The container sitecustomize force-registers the TPU platform
         # programmatically; the env var alone does not override it.
         jax.config.update("jax_platforms", "cpu")
+
+
+def _make_timer(batch: int, steps: int, warmup: int):
+    """items/sec timer for step(state..., batch) -> (state..., loss)."""
+    import jax
+
+    def timed(step, state, batch_parts):
+        state = step(*state, batch_parts)  # warm compile
+        for _ in range(warmup - 1):
+            state = step(*state[:-1], batch_parts)
+        jax.block_until_ready(state)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state = step(*state[:-1], batch_parts)
+        jax.block_until_ready(state)
+        return batch * steps / (time.perf_counter() - t0)
+
+    return timed
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=0, help="global batch "
+                   "(default: 64 per chip; bert: 8 per chip)")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--model", choices=["resnet50", "bert"],
+                   default="resnet50",
+                   help="bert = BERT-Large MLM (BASELINE.md config 2)")
+    p.add_argument("--seq-len", type=int, default=128, help="bert only")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes for a fast correctness pass")
+    args = p.parse_args()
+    if args.model == "bert":
+        return bench_bert(args)
+
+    _maybe_force_cpu()
+    import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -62,17 +91,7 @@ def main() -> None:
     variables = model.init(jax.random.PRNGKey(0), x[:1], train=False)
     tx = optax.sgd(0.1, momentum=0.9)
 
-    def timed(step, state, batch_parts):
-        state = step(*state, batch_parts)  # warm compile
-        for _ in range(args.warmup - 1):
-            state = step(*state[:-1], batch_parts)
-        jax.block_until_ready(state)
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            state = step(*state[:-1], batch_parts)
-        jax.block_until_ready(state)
-        dt = time.perf_counter() - t0
-        return batch * args.steps / dt
+    timed = _make_timer(batch, args.steps, args.warmup)
 
     # --- plain JAX baseline (no sync framework) ---
     # Runs FIRST: the framework step donates its inputs, and on some
@@ -120,6 +139,73 @@ def main() -> None:
                   if not args.smoke else "resnet18_smoke_imgs_per_sec",
         "value": round(bench_ips / n_dev, 2),
         "unit": "images/sec/chip",
+        "vs_baseline": round(bench_ips / n_dev / plain_ips, 4),
+    }))
+
+
+def bench_bert(args) -> None:
+    """BERT-Large MLM training throughput (sequences/sec/chip) through the
+    full byteps_tpu step vs a plain-JAX single-chip baseline."""
+    _maybe_force_cpu()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import byteps_tpu.jax as bps
+    from byteps_tpu.jax.training import (make_train_step, replicate,
+                                         shard_batch)
+    from byteps_tpu.models import BertBase, BertLarge, masked_lm_loss
+
+    n_dev = len(jax.devices())
+    if args.smoke:
+        model = BertBase(num_layers=2, d_model=64, num_heads=4, mlp_dim=128,
+                         vocab_size=1024, max_len=64, dtype=jnp.float32)
+        seq, batch = 32, max(8, n_dev)
+        args.steps = min(args.steps, 5)
+    else:
+        model = BertLarge(dtype=jnp.bfloat16)
+        seq = args.seq_len
+        batch = args.batch or 8 * n_dev
+
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, 1000, (batch, seq)), jnp.int32)
+    mask = jnp.asarray(rng.integers(0, 2, (batch, seq)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks[:1])
+    tx = optax.adamw(1e-4)
+
+    def loss_fn(p, batch_):
+        t, m = batch_
+        return masked_lm_loss(model.apply(p, t), t, m)
+
+    timed = _make_timer(batch, args.steps, args.warmup)
+
+    # plain-JAX single-chip baseline on the per-chip batch (run FIRST: the
+    # framework step donates its buffers)
+    @jax.jit
+    def plain_step(p, opt_state, batch_):
+        loss, g = jax.value_and_grad(loss_fn)(p, batch_)
+        u, opt_state = tx.update(g, opt_state, p)
+        return optax.apply_updates(p, u), opt_state, loss
+
+    per_chip = max(1, batch // n_dev)
+    plain_ips = timed(plain_step, (params, tx.init(params)),
+                      (toks[:per_chip], mask[:per_chip]))
+    plain_ips = plain_ips * per_chip / batch
+
+    bps.init()
+    mesh = bps.mesh()
+    # The framework step: hierarchical push_pull + donated buffers; in PS
+    # mode this routes the DCN leg through the C++ KV client.
+    bps_step = make_train_step(loss_fn, tx, mesh)
+    state = (replicate(params, mesh), replicate(tx.init(params), mesh))
+    bench_ips = timed(bps_step, state, shard_batch((toks, mask), mesh))
+
+    print(json.dumps({
+        "metric": "bert_large_mlm_seqs_per_sec_per_chip"
+                  if not args.smoke else "bert_smoke_seqs_per_sec",
+        "value": round(bench_ips / n_dev, 2),
+        "unit": "sequences/sec/chip",
         "vs_baseline": round(bench_ips / n_dev / plain_ips, 4),
     }))
 

@@ -19,3 +19,12 @@ from byteps_tpu.parallel.hierarchical import (  # noqa: F401
     tree_all_reduce,
     tree_broadcast,
 )
+from byteps_tpu.parallel.ring_attention import (  # noqa: F401
+    full_attention,
+    ring_attention,
+    ring_attention_sharded,
+)
+from byteps_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
