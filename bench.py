@@ -30,11 +30,13 @@ def _maybe_force_cpu() -> None:
         jax.config.update("jax_platforms", "cpu")
 
 
-def _make_timer(batch: int, steps: int, warmup: int):
-    """items/sec timer for step(state..., batch) -> (state..., loss)."""
+def _make_timer(steps: int, warmup: int):
+    """items/sec timer for step(state..., batch) -> (state..., loss).
+    ``items`` is the item count the supplied batch actually carries, so no
+    post-hoc rescaling exists to forget."""
     import jax
 
-    def timed(step, state, batch_parts):
+    def timed(step, state, batch_parts, items: int):
         state = step(*state, batch_parts)  # warm compile
         for _ in range(warmup - 1):
             state = step(*state[:-1], batch_parts)
@@ -43,7 +45,7 @@ def _make_timer(batch: int, steps: int, warmup: int):
         for _ in range(steps):
             state = step(*state[:-1], batch_parts)
         jax.block_until_ready(state)
-        return batch * steps / (time.perf_counter() - t0)
+        return items * steps / (time.perf_counter() - t0)
 
     return timed
 
@@ -91,7 +93,7 @@ def main() -> None:
     variables = model.init(jax.random.PRNGKey(0), x[:1], train=False)
     tx = optax.sgd(0.1, momentum=0.9)
 
-    timed = _make_timer(batch, args.steps, args.warmup)
+    timed = _make_timer(args.steps, args.warmup)
 
     # --- plain JAX baseline (no sync framework) ---
     # Runs FIRST: the framework step donates its inputs, and on some
@@ -121,9 +123,8 @@ def main() -> None:
     per_chip = max(1, batch // n_dev)
     state2 = (variables["params"], variables["batch_stats"],
               tx.init(variables["params"]))
-    plain_ips = timed(plain_step, state2, (x[:per_chip], y[:per_chip]))
-    # timed() multiplies by the global `batch`; rescale to what it ran.
-    plain_ips = plain_ips * per_chip / batch
+    plain_ips = timed(plain_step, state2, (x[:per_chip], y[:per_chip]),
+                      per_chip)
 
     # --- byteps_tpu path ---
     bps.init()
@@ -132,7 +133,7 @@ def main() -> None:
     state = (replicate(variables["params"], mesh),
              replicate(variables["batch_stats"], mesh),
              replicate(tx.init(variables["params"]), mesh))
-    bench_ips = timed(step, state, shard_batch((x, y), mesh))
+    bench_ips = timed(step, state, shard_batch((x, y), mesh), batch)
 
     print(json.dumps({
         "metric": "resnet50_train_imgs_per_sec_per_chip"
@@ -166,6 +167,10 @@ def bench_bert(args) -> None:
     else:
         model = BertLarge(dtype=jnp.bfloat16)
         seq = args.seq_len
+        if seq > model.max_len:
+            raise SystemExit(
+                f"--seq-len {seq} exceeds BERT max_len={model.max_len} "
+                "(position embeddings would clamp silently)")
         batch = args.batch or 8 * n_dev
 
     rng = np.random.default_rng(0)
@@ -178,7 +183,7 @@ def bench_bert(args) -> None:
         t, m = batch_
         return masked_lm_loss(model.apply(p, t), t, m)
 
-    timed = _make_timer(batch, args.steps, args.warmup)
+    timed = _make_timer(args.steps, args.warmup)
 
     # plain-JAX single-chip baseline on the per-chip batch (run FIRST: the
     # framework step donates its buffers)
@@ -190,8 +195,7 @@ def bench_bert(args) -> None:
 
     per_chip = max(1, batch // n_dev)
     plain_ips = timed(plain_step, (params, tx.init(params)),
-                      (toks[:per_chip], mask[:per_chip]))
-    plain_ips = plain_ips * per_chip / batch
+                      (toks[:per_chip], mask[:per_chip]), per_chip)
 
     bps.init()
     mesh = bps.mesh()
@@ -199,7 +203,8 @@ def bench_bert(args) -> None:
     # mode this routes the DCN leg through the C++ KV client.
     bps_step = make_train_step(loss_fn, tx, mesh)
     state = (replicate(params, mesh), replicate(tx.init(params), mesh))
-    bench_ips = timed(bps_step, state, shard_batch((toks, mask), mesh))
+    bench_ips = timed(bps_step, state, shard_batch((toks, mask), mesh),
+                      batch)
 
     print(json.dumps({
         "metric": "bert_large_mlm_seqs_per_sec_per_chip"

@@ -1,0 +1,8 @@
+"""TPU kernel library (Pallas) for the hot ops.
+
+The reference's native performance layer is C++/NCCL (SURVEY.md §2.1); on
+TPU the equivalent "hand-tuned hot path" lives in Pallas kernels that feed
+the MXU and keep working sets in VMEM.
+"""
+
+from byteps_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,203 @@
+"""Pallas TPU flash attention (forward kernel + training VJP).
+
+Blockwise attention with online softmax: Q blocks in VMEM, the kernel
+streams K/V blocks and keeps only O(block) state — never materialising the
+[S, S] score matrix in HBM. Block matmuls hit the MXU at the (128, 128)
+tile shape; masking (causal / key padding) is computed on the VPU with
+broadcasted iota. Per /opt/skills/guides/pallas_guide.md patterns: grid
+iterates (batch*heads, q_block, k_block) with the k_block dimension
+innermost so VMEM scratch carries the running (m, l, acc) across K steps.
+
+Layout contract matches byteps_tpu.parallel attention: [batch, seq, heads,
+head_dim]; any dtype (bf16 hot path), f32 accumulation.
+
+The backward pass is a custom VJP that recomputes attention with the
+XLA reference implementation (exact same math, compiler-fused); a Pallas
+backward kernel is a later optimisation, the VJP boundary already makes
+the forward kernel trainable. Off-TPU the kernel runs in interpret mode,
+so tests exercise the real kernel code path on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU builds too; guard for safety
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+               scale: float, causal: bool, block_q: int, block_k: int,
+               seq_k: int):
+    """One (bh, qi, ki) grid step of blockwise attention."""
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _compute():
+        q = q_ref[0]                       # [block_q, d]
+        k = k_ref[0]                       # [block_k, d]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < seq_k               # key padding
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:, 0:1]             # [bq, 1]
+        m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_cur)
+        corr = jnp.exp(m_prev - m_cur)     # [bq, 1]
+        # m/l live in 128-lane scratch rows (VMEM tiling); lane 0 is the
+        # value, writes broadcast across lanes.
+        l_new = l_ref[:, 0:1] * corr + p.sum(axis=-1, keepdims=True)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * corr + pv
+
+    if causal:
+        # k_start/q_start are traced (program_id); predicate at runtime.
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        # Fully-masked rows (query padding) have l == 0; guard the divide.
+        l = l_ref[:, 0:1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _pad_to(x, multiple: int, axis: int):
+    n = x.shape[axis]
+    pad = (-n) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention over [batch, seq, heads, head_dim] arrays.
+
+    Exact softmax attention, O(seq) memory. ``interpret=None`` auto-selects
+    interpret mode off-TPU (tests run the same kernel on CPU). Drop-in for
+    ``byteps_tpu.parallel.full_attention``, including as the inner kernel
+    of ``ulysses_attention(attn_fn=...)``.
+    """
+    return _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                           interpret)
+
+
+def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    bq = min(block_q, max(s_q, 8))
+    bk = min(block_k, max(s_k, 8))
+
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    qq = _pad_to(to_bhsd(q), bq, axis=1)
+    kk = _pad_to(to_bhsd(k), bk, axis=1)
+    vv = _pad_to(to_bhsd(v), bk, axis=1)
+    sq_p, sk_p = qq.shape[1], kk.shape[1]
+
+    grid = (b * h, sq_p // bq, sk_p // bk)
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        seq_k=s_k)
+    scratch = [
+        _VMEM((bq, 128), jnp.float32),  # m (value in lane 0)
+        _VMEM((bq, 128), jnp.float32),  # l (value in lane 0)
+        _VMEM((bq, d), jnp.float32),    # acc
+    ]
+    vmem = pl.BlockSpec
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            vmem((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
+                 memory_space=_VMEM),
+            vmem((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
+                 memory_space=_VMEM),
+            vmem((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
+                 memory_space=_VMEM),
+        ],
+        out_specs=vmem((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
+                       memory_space=_VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(qq, kk, vv)
+    out = out[:, :s_q].reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    """Backward via XLA recompute of the exact same attention math."""
+    from byteps_tpu.parallel.ring_attention import full_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: full_attention(q_, k_, v_, causal=causal,
+                                          scale=scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

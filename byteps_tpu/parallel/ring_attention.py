@@ -85,7 +85,7 @@ def ring_attention(
     if n == 1:
         return _single_device_attention(q, k, v, causal=causal, scale=scale)
 
-    q32 = q.astype(jnp.float32) if q.dtype == jnp.float64 else q
+    q32 = q
     m0 = jnp.full((b, h, s_q), _big_neg(jnp.float32), jnp.float32)
     l0 = jnp.zeros((b, h, s_q), jnp.float32)
     o0 = jnp.zeros((b, s_q, h, d), jnp.float32)
@@ -140,22 +140,25 @@ def full_attention(q, k, v, *, causal: bool = False,
     return _single_device_attention(q, k, v, causal=causal, scale=scale)
 
 
-def ring_attention_sharded(q, k, v, mesh, *, axis: str = "sp",
-                           causal: bool = False,
-                           scale: Optional[float] = None):
-    """Convenience wrapper: global [B, S, H, D] arrays in, jitted
-    shard_map'd ring attention over ``mesh``'s ``axis`` out."""
+@partial(jax.jit, static_argnums=(3, 4, 5, 6))
+def _ring_sharded_impl(q, k, v, mesh, axis, causal, scale):
     from jax.sharding import PartitionSpec as P
 
     from byteps_tpu.jax._compat import shard_map as _shard_map
 
     spec = P(None, axis, None, None)
+    run = _shard_map(
+        lambda ql, kl, vl: ring_attention(ql, kl, vl, axis=axis,
+                                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return run(q, k, v)
 
-    @jax.jit
-    @partial(_shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-             out_specs=spec, check_vma=False)
-    def _run(ql, kl, vl):
-        return ring_attention(ql, kl, vl, axis=axis, causal=causal,
-                              scale=scale)
 
-    return _run(q, k, v)
+def ring_attention_sharded(q, k, v, mesh, *, axis: str = "sp",
+                           causal: bool = False,
+                           scale: Optional[float] = None):
+    """Convenience wrapper: global [B, S, H, D] arrays in, jitted
+    shard_map'd ring attention over ``mesh``'s ``axis`` out. The jit cache
+    is keyed on (mesh, axis, causal, scale) — loops don't recompile."""
+    return _ring_sharded_impl(q, k, v, mesh, axis, causal, scale)

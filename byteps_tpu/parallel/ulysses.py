@@ -75,23 +75,29 @@ def ulysses_attention(
     return _heads_to_seq(out, axis)
 
 
-def ulysses_attention_sharded(q, k, v, mesh, *, axis: str = "sp",
-                              causal: bool = False,
-                              scale: Optional[float] = None,
-                              attn_fn: Optional[AttnFn] = None):
-    """Convenience wrapper: global [B, S, H, D] arrays in, jitted
-    shard_map'd Ulysses attention over ``mesh``'s ``axis`` out."""
+@partial(jax.jit, static_argnums=(3, 4, 5, 6, 7))
+def _ulysses_sharded_impl(q, k, v, mesh, axis, causal, scale, attn_fn):
     from jax.sharding import PartitionSpec as P
 
     from byteps_tpu.jax._compat import shard_map as _shard_map
 
     spec = P(None, axis, None, None)
+    run = _shard_map(
+        lambda ql, kl, vl: ulysses_attention(ql, kl, vl, axis=axis,
+                                             causal=causal, scale=scale,
+                                             attn_fn=attn_fn),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return run(q, k, v)
 
-    @jax.jit
-    @partial(_shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-             out_specs=spec, check_vma=False)
-    def _run(ql, kl, vl):
-        return ulysses_attention(ql, kl, vl, axis=axis, causal=causal,
-                                 scale=scale, attn_fn=attn_fn)
 
-    return _run(q, k, v)
+def ulysses_attention_sharded(q, k, v, mesh, *, axis: str = "sp",
+                              causal: bool = False,
+                              scale: Optional[float] = None,
+                              attn_fn: Optional[AttnFn] = None):
+    """Convenience wrapper: global [B, S, H, D] arrays in, jitted
+    shard_map'd Ulysses attention over ``mesh``'s ``axis`` out. The jit
+    cache is keyed on (mesh, axis, causal, scale, attn_fn) — loops don't
+    recompile (pass a stable ``attn_fn``, not a fresh lambda per call)."""
+    return _ulysses_sharded_impl(q, k, v, mesh, axis, causal, scale,
+                                 attn_fn)
