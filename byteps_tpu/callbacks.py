@@ -1,0 +1,136 @@
+"""Horovod/Keras-compatible training callbacks for JAX training loops.
+
+Capability parity: the reference's byteps/keras plugin (SURVEY.md §2.5):
+``BroadcastGlobalVariablesCallback``, ``MetricAverageCallback``,
+``LearningRateWarmupCallback`` — the same names and semantics, adapted to
+functional JAX loops. A loop drives them through the small ``CallbackList``
+protocol (on_train_begin / on_epoch_end / on_batch_end), or uses the optax
+schedule builders directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+import byteps_tpu.jax as bps
+
+
+class Callback:
+    """Protocol: a training loop calls these around its epochs/batches.
+    ``state`` is the loop's mutable dict (params, opt_state, metrics...)."""
+
+    def on_train_begin(self, state: Dict[str, Any]) -> None: ...
+    def on_epoch_begin(self, epoch: int, state: Dict[str, Any]) -> None: ...
+    def on_epoch_end(self, epoch: int, state: Dict[str, Any]) -> None: ...
+    def on_batch_end(self, batch: int, state: Dict[str, Any]) -> None: ...
+
+
+class CallbackList(Callback):
+    def __init__(self, callbacks: List[Callback]):
+        self._cbs = list(callbacks)
+
+    def on_train_begin(self, state):
+        for cb in self._cbs:
+            cb.on_train_begin(state)
+
+    def on_epoch_begin(self, epoch, state):
+        for cb in self._cbs:
+            cb.on_epoch_begin(epoch, state)
+
+    def on_epoch_end(self, epoch, state):
+        for cb in self._cbs:
+            cb.on_epoch_end(epoch, state)
+
+    def on_batch_end(self, batch, state):
+        for cb in self._cbs:
+            cb.on_batch_end(batch, state)
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Sync ``state['params']`` (and opt_state if present) from root at
+    train begin — the reference's init-time weight sync as a callback."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, state):
+        for key in ("params", "batch_stats"):
+            if state.get(key) is not None:
+                state[key] = bps.broadcast_parameters(
+                    state[key], root_rank=self.root_rank)
+        if state.get("opt_state") is not None:
+            # optimizer state may hold non-array leaves (schedules, step
+            # counters) — broadcast_optimizer_state skips those
+            state["opt_state"] = bps.broadcast_optimizer_state(
+                state["opt_state"], root_rank=self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Average ``state['metrics']`` across all workers at epoch end
+    (reference: keras MetricAverageCallback)."""
+
+    def on_epoch_end(self, epoch, state):
+        metrics = state.get("metrics")
+        if not metrics:
+            return
+        keys = sorted(metrics)
+        vals = np.asarray([float(metrics[k]) for k in keys], np.float32)
+        st = bps._st()
+        if st.ps_client is not None:
+            from byteps_tpu.jax.ps import ps_push_pull
+            out = ps_push_pull(vals, average=True, prefix="metric_avg")
+            vals = np.asarray(out)
+        # Single-controller collective mode: metrics from a shard_map'd
+        # step are already globally reduced (pmean in the step), so this
+        # is the identity there — matching Horovod semantics where each
+        # process holds a local value.
+        state["metrics"] = {k: float(v) for k, v in zip(keys, vals)}
+
+
+class LearningRateWarmupCallback(Callback):
+    """Horovod-style LR warmup: scale from ``initial_lr`` to
+    ``initial_lr * multiplier`` over ``warmup_epochs``. The loop reads
+    ``state['lr']`` each step (or use ``warmup_schedule`` with optax)."""
+
+    def __init__(self, initial_lr: float, multiplier: float,
+                 warmup_epochs: int = 5, steps_per_epoch: int = 1,
+                 verbose: bool = False):
+        self.initial_lr = initial_lr
+        self.multiplier = multiplier
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self._batches = 0
+
+    def _lr(self) -> float:
+        total = max(1, self.warmup_epochs * self.steps_per_epoch)
+        frac = min(1.0, self._batches / total)
+        return self.initial_lr * (1.0 + frac * (self.multiplier - 1.0))
+
+    def on_train_begin(self, state):
+        state["lr"] = self._lr()
+
+    def on_batch_end(self, batch, state):
+        self._batches += 1
+        state["lr"] = self._lr()
+        if self.verbose and self._batches % self.steps_per_epoch == 0:
+            print(f"warmup lr -> {state['lr']:.6f}")
+
+
+def warmup_schedule(base_lr: float, multiplier: Optional[float] = None,
+                    warmup_steps: int = 1000):
+    """optax learning-rate schedule: linear warmup from ``base_lr`` to
+    ``base_lr * multiplier`` (default: the device count — Horovod's
+    'scale lr by workers' recipe), constant after."""
+    import jax.numpy as jnp
+
+    def schedule(step):
+        mult = multiplier if multiplier is not None else float(
+            bps.device_count() if bps.initialized() else jax.device_count())
+        frac = jnp.minimum(1.0, step / max(1, warmup_steps))
+        return base_lr * (1.0 + frac * (mult - 1.0))
+
+    return schedule

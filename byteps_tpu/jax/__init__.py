@@ -45,8 +45,8 @@ from byteps_tpu.jax._compat import shard_map as _shard_map
 __all__ = [
     "init", "shutdown", "initialized", "rank", "size", "device_count",
     "local_rank", "local_size", "push_pull", "push_pull_async", "poll", "synchronize",
-    "declare_tensor", "broadcast_parameters", "DistributedOptimizer",
-    "Compression", "mesh",
+    "declare_tensor", "broadcast_parameters", "broadcast_optimizer_state",
+    "DistributedOptimizer", "Compression", "mesh",
 ]
 
 
@@ -283,6 +283,16 @@ def broadcast_parameters(tree, root_rank: int = 0):
     st = _st()
     repl = jax.sharding.NamedSharding(st.mesh, P())
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, repl), tree)
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0):
+    """Replicate optimizer state from ``root_rank`` (reference:
+    broadcast_optimizer_state). optax states are pytrees of arrays, so
+    this shares broadcast_parameters' mechanics; non-array leaves (python
+    scalars, schedule callables) pass through untouched."""
+    return jax.tree_util.tree_map(
+        lambda x: broadcast_parameters(x, root_rank=root_rank)
+        if hasattr(x, "dtype") else x, opt_state)
 
 
 # --- DistributedOptimizer ---------------------------------------------------

@@ -9,6 +9,7 @@ MLP used by the test suite.
 
 from byteps_tpu.models.mlp import MLP  # noqa: F401
 from byteps_tpu.models.resnet import ResNet, ResNet18, ResNet50  # noqa: F401
+from byteps_tpu.models.vgg import VGG, VGG16, VGG19  # noqa: F401
 from byteps_tpu.models.transformer import (  # noqa: F401
     BertBase,
     BertLarge,

@@ -1,0 +1,47 @@
+"""VGG in flax — the reference's second headline throughput model.
+
+Reference analogue: the BytePS README/docs benchmark VGG-16 alongside
+ResNet-50 (SURVEY.md §6: "VGG-16 images/sec vs Horovod ≈ +100%") because
+its huge dense gradients stress the communication layer hardest. Same
+TPU-first choices as resnet.py: bf16, NHWC, static shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Conv filter counts per stage; "M" = 2x2 max-pool.
+_VGG16 = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+          512, 512, 512, "M", 512, 512, 512, "M")
+_VGG19 = (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+          512, 512, 512, 512, "M", 512, 512, 512, 512, "M")
+
+
+class VGG(nn.Module):
+    cfg: Sequence = _VGG16
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for i, c in enumerate(self.cfg):
+            if c == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(c, (3, 3), padding="SAME", dtype=self.dtype,
+                            name=f"conv_{i}")(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc2")(x))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc3")(x)
+        return x.astype(jnp.float32)
+
+
+VGG16 = partial(VGG, cfg=_VGG16)
+VGG19 = partial(VGG, cfg=_VGG19)

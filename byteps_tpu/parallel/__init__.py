@@ -28,3 +28,4 @@ from byteps_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention,
     ulysses_attention_sharded,
 )
+from byteps_tpu.parallel.moe import moe_dispatch, moe_ffn  # noqa: F401

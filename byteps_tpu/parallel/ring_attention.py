@@ -85,7 +85,6 @@ def ring_attention(
     if n == 1:
         return _single_device_attention(q, k, v, causal=causal, scale=scale)
 
-    q32 = q
     m0 = jnp.full((b, h, s_q), _big_neg(jnp.float32), jnp.float32)
     l0 = jnp.zeros((b, h, s_q), jnp.float32)
     o0 = jnp.zeros((b, s_q, h, d), jnp.float32)
@@ -102,7 +101,7 @@ def ring_attention(
         # matmuls, so the ICI permute overlaps the block compute.
         kv_next = jax.tree_util.tree_map(
             lambda x: lax.ppermute(x, axis, perm), kv)
-        m, l, o = _block_attn(q32, k_blk, v_blk, m, l, o,
+        m, l, o = _block_attn(q, k_blk, v_blk, m, l, o,
                               q_pos, k_pos, causal, scale)
         return (kv_next, m, l, o), None
 
@@ -113,7 +112,7 @@ def ring_attention(
         step, ((k, v), m0, l0, o0), jnp.arange(n - 1))
     src = (my - (n - 1)) % n
     k_pos = src * s_q + jnp.arange(kv_last[0].shape[1])
-    m, l, o = _block_attn(q32, kv_last[0], kv_last[1], m, l, o,
+    m, l, o = _block_attn(q, kv_last[0], kv_last[1], m, l, o,
                           q_pos, k_pos, causal, scale)
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)

@@ -1,0 +1,8 @@
+"""Auxiliary subsystems: checkpoint/resume, trace timeline."""
+
+from byteps_tpu.utils.checkpoint import (  # noqa: F401
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from byteps_tpu.utils.timeline import Timeline  # noqa: F401

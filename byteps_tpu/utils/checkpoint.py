@@ -1,0 +1,99 @@
+"""Checkpoint / resume utilities (orbax-backed).
+
+The reference delegates checkpointing to the user's framework and supplies
+only the resume-support surface — rank 0 loads, then broadcast_parameters /
+broadcast_optimizer_state re-sync the fleet (SURVEY.md §5 "Checkpoint /
+resume"). On TPU preemption is routine, so we ship the full pattern:
+``save_checkpoint`` (rank-0-writes), ``restore_checkpoint`` (load then
+broadcast), ``latest_step`` discovery. State is any pytree (params,
+opt_state, batch_stats, step counters, ...).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _ckpt_dir(base: str, step: int) -> str:
+    return os.path.join(base, f"step_{step}")
+
+
+def save_checkpoint(base_dir: str, state: Any, step: int,
+                    *, keep: int = 3, rank: Optional[int] = None) -> str:
+    """Write ``state`` under ``base_dir/step_<step>`` and prune old steps.
+
+    Only the coordinating process writes (rank 0 by default — pass
+    ``rank`` explicitly in multi-controller jobs); other ranks return
+    immediately, mirroring the reference's rank-0-saves convention.
+    """
+    import orbax.checkpoint as ocp
+
+    if rank is None:
+        rank = jax.process_index()
+    path = _ckpt_dir(base_dir, step)
+    if rank != 0:
+        return path
+    # Materialise on host first (orbax handles jax arrays, but host numpy
+    # keeps the write path independent of device state).
+    host_state = jax.tree_util.tree_map(np.asarray, state)
+    ckpt = ocp.PyTreeCheckpointer()
+    ckpt.save(os.path.abspath(path), host_state, force=True)
+    _prune(base_dir, keep)
+    return path
+
+
+def latest_step(base_dir: str) -> Optional[int]:
+    """Largest step with a saved checkpoint, or None."""
+    if not os.path.isdir(base_dir):
+        return None
+    steps = [int(m.group(1)) for name in os.listdir(base_dir)
+             if (m := _STEP_RE.match(name))]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(base_dir: str, target: Any,
+                       step: Optional[int] = None, *,
+                       broadcast: bool = True) -> tuple:
+    """Restore ``(state, step)``; ``target`` supplies the pytree structure
+    and dtypes. With ``broadcast=True`` the restored tree is re-synced to
+    every device/worker through ``broadcast_parameters`` — the reference's
+    resume pattern (rank 0 loads, broadcasts to all).
+
+    Returns ``(target, None)`` unchanged when no checkpoint exists.
+    """
+    import orbax.checkpoint as ocp
+
+    if step is None:
+        step = latest_step(base_dir)
+        if step is None:
+            return target, None
+    ckpt = ocp.PyTreeCheckpointer()
+    # Restore INTO the target's structure (orbax matches by tree path, not
+    # flatten order) — a NamedTuple/dict mix-up can otherwise silently pair
+    # values with the wrong fields.
+    host_target = jax.tree_util.tree_map(np.asarray, target)
+    host_state = ckpt.restore(os.path.abspath(_ckpt_dir(base_dir, step)),
+                              item=host_target)
+    state = jax.tree_util.tree_map(
+        lambda t, r: np.asarray(r).astype(np.asarray(t).dtype).reshape(
+            np.shape(t)), target, host_state)
+    if broadcast:
+        import byteps_tpu.jax as bps
+        if bps.initialized():
+            state = bps.broadcast_parameters(state, root_rank=0)
+    return state, step
+
+
+def _prune(base_dir: str, keep: int) -> None:
+    steps = sorted(int(m.group(1)) for name in os.listdir(base_dir)
+                   if (m := _STEP_RE.match(name)))
+    for s in steps[:-keep] if keep > 0 else []:
+        import shutil
+        shutil.rmtree(_ckpt_dir(base_dir, s), ignore_errors=True)

@@ -1,0 +1,112 @@
+"""Communication trace timeline (Chrome trace-event JSON).
+
+Capability parity: the reference's built-in timeline (SURVEY.md §5
+"Tracing / profiling": BYTEPS_TRACE_ON / BYTEPS_TRACE_DIR /
+BYTEPS_TRACE_START_STEP / BYTEPS_TRACE_END_STEP; per-partition stage
+timestamps dumped as Chrome trace-event JSON per rank).
+
+Two sources feed the timeline:
+- the C++ core's per-partition stage spans (compress / push / pull),
+  drained via ``bps_dump_trace`` — the DCN leg;
+- ``jax.profiler`` for the on-device stages (the ICI leg), started and
+  stopped over the same step window so both views line up.
+
+Usage::
+
+    tl = Timeline()            # reads BYTEPS_TRACE_* from the config
+    for batch in data:
+        step(...)
+        tl.step()              # call once per training step
+    tl.close()                 # idempotent; also dumps on end-step
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from byteps_tpu.config import Config, get_config
+
+
+class Timeline:
+    """Step-windowed trace recorder (reference: BytePSContext timestamps +
+    the trace dump on BYTEPS_TRACE_END_STEP)."""
+
+    def __init__(self, config: Optional[Config] = None,
+                 *, device_trace: bool = True):
+        self._cfg = config or get_config()
+        self._enabled = self._cfg.trace_on
+        self._device_trace = device_trace
+        self._step = 0
+        self._profiling = False
+        self._dumped = False
+        if self._enabled:
+            os.makedirs(self._cfg.trace_dir, exist_ok=True)
+
+    @property
+    def active(self) -> bool:
+        """True while the current step is inside the trace window."""
+        return (self._enabled and not self._dumped
+                and self._step >= self._cfg.trace_start_step)
+
+    def step(self) -> None:
+        """Mark the end of one training step."""
+        if not self._enabled or self._dumped:
+            return
+        self._step += 1
+        if (self._step >= self._cfg.trace_start_step
+                and not self._profiling and self._device_trace
+                and self._step < self._cfg.trace_end_step):
+            self._start_device_trace()
+        if self._step >= self._cfg.trace_end_step:
+            self.close()
+
+    def close(self) -> None:
+        """Dump both trace sources (idempotent)."""
+        if not self._enabled or self._dumped:
+            return
+        self._dumped = True
+        self._stop_device_trace()
+        self._dump_core_trace()
+
+    # --- internals ---------------------------------------------------------
+
+    def _rank(self) -> int:
+        try:
+            import byteps_tpu.jax as bps
+            if bps.initialized():
+                return bps.rank()
+        except Exception:
+            pass
+        return self._cfg.worker_id
+
+    def _dump_core_trace(self) -> None:
+        """Drain the C++ worker's per-partition spans into Chrome JSON."""
+        try:
+            import byteps_tpu.jax as bps
+            client = bps._st().ps_client if bps.initialized() else None
+        except Exception:
+            client = None
+        if client is None:
+            return
+        path = os.path.join(self._cfg.trace_dir,
+                            f"comm_rank{self._rank()}.json")
+        client.dump_trace(path)
+
+    def _start_device_trace(self) -> None:
+        try:
+            import jax
+            jax.profiler.start_trace(
+                os.path.join(self._cfg.trace_dir,
+                             f"device_rank{self._rank()}"))
+            self._profiling = True
+        except Exception:
+            self._profiling = False
+
+    def _stop_device_trace(self) -> None:
+        if self._profiling:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._profiling = False

@@ -1,0 +1,159 @@
+"""Aux subsystems: checkpoint/resume, timeline, callbacks,
+broadcast_optimizer_state."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import byteps_tpu.jax as bps
+from byteps_tpu.callbacks import (BroadcastGlobalVariablesCallback,
+                                  CallbackList, LearningRateWarmupCallback,
+                                  MetricAverageCallback, warmup_schedule)
+from byteps_tpu.config import Config
+from byteps_tpu.utils import (Timeline, latest_step, restore_checkpoint,
+                              save_checkpoint)
+
+
+def _state(rng):
+    return {
+        "params": {"w": jnp.asarray(rng.standard_normal((4, 3)), jnp.float32),
+                   "b": jnp.zeros((3,), jnp.float32)},
+        "step": jnp.asarray(7, jnp.int32),
+    }
+
+
+def test_checkpoint_roundtrip(tmp_path, rng):
+    base = str(tmp_path / "ckpt")
+    state = _state(rng)
+    save_checkpoint(base, state, step=10)
+    save_checkpoint(base, jax.tree_util.tree_map(lambda x: x + 1, state),
+                    step=20)
+    assert latest_step(base) == 20
+
+    target = jax.tree_util.tree_map(jnp.zeros_like, state)
+    restored, step = restore_checkpoint(base, target, broadcast=False)
+    assert step == 20
+    np.testing.assert_allclose(np.asarray(restored["params"]["w"]),
+                               np.asarray(state["params"]["w"]) + 1)
+    # explicit older step
+    restored10, step10 = restore_checkpoint(base, target, step=10,
+                                            broadcast=False)
+    assert step10 == 10
+    np.testing.assert_allclose(np.asarray(restored10["params"]["w"]),
+                               np.asarray(state["params"]["w"]))
+
+
+def test_checkpoint_prune(tmp_path, rng):
+    base = str(tmp_path / "ckpt")
+    state = _state(rng)
+    for s in (1, 2, 3, 4, 5):
+        save_checkpoint(base, state, step=s, keep=2)
+    kept = sorted(os.listdir(base))
+    assert kept == ["step_4", "step_5"]
+
+
+def test_checkpoint_namedtuple_field_order(tmp_path):
+    """Regression: NamedTuple fields whose alphabetical order differs from
+    declaration order must restore into the RIGHT fields (restore matches
+    by tree path, not flatten order)."""
+    from typing import NamedTuple
+
+    class TS(NamedTuple):
+        step: jnp.ndarray   # 's' sorts after 'b'
+        bias: jnp.ndarray
+
+    state = TS(step=jnp.asarray(1.0), bias=jnp.asarray(7.0))
+    base = str(tmp_path / "ckpt")
+    save_checkpoint(base, state, step=1)
+    target = TS(step=jnp.asarray(0.0), bias=jnp.asarray(0.0))
+    restored, _ = restore_checkpoint(base, target, broadcast=False)
+    assert float(restored.step) == 1.0
+    assert float(restored.bias) == 7.0
+
+
+def test_checkpoint_missing_returns_target(tmp_path, rng):
+    target = _state(rng)
+    out, step = restore_checkpoint(str(tmp_path / "none"), target)
+    assert step is None and out is target
+
+
+def test_checkpoint_restore_with_broadcast(tmp_path, rng):
+    bps.init()
+    base = str(tmp_path / "ckpt")
+    state = _state(rng)
+    save_checkpoint(base, state, step=1)
+    restored, step = restore_checkpoint(base, state, broadcast=True)
+    assert step == 1
+    np.testing.assert_allclose(np.asarray(restored["params"]["w"]),
+                               np.asarray(state["params"]["w"]))
+
+
+def test_broadcast_optimizer_state(rng):
+    bps.init()
+    tx = optax.adam(1e-3)
+    params = {"w": jnp.ones((3, 2))}
+    st = tx.update(params, tx.init(params), params)[1]  # stepped state
+    out = bps.broadcast_optimizer_state(st)
+    flat1 = jax.tree_util.tree_leaves(st)
+    flat2 = jax.tree_util.tree_leaves(out)
+    assert len(flat1) == len(flat2)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_timeline_window(tmp_path, monkeypatch):
+    cfg = Config(trace_on=True, trace_dir=str(tmp_path / "tr"),
+                 trace_start_step=2, trace_end_step=4)
+    tl = Timeline(cfg, device_trace=False)
+    assert not tl.active
+    tl.step()            # step 1: before window
+    assert not tl.active
+    tl.step()            # step 2: window opens
+    assert tl.active
+    tl.step()            # step 3
+    tl.step()            # step 4: dump + close
+    assert not tl.active
+    assert os.path.isdir(cfg.trace_dir)
+    tl.step()            # past end: no-op
+    tl.close()           # idempotent
+
+
+def test_timeline_disabled():
+    tl = Timeline(Config(trace_on=False), device_trace=False)
+    for _ in range(5):
+        tl.step()
+    assert not tl.active
+
+
+def test_callbacks_warmup_and_broadcast(rng):
+    bps.init()
+    state = {"params": {"w": jnp.ones((2, 2))}, "opt_state": None,
+             "metrics": {"loss": 3.0}}
+    cbs = CallbackList([
+        BroadcastGlobalVariablesCallback(root_rank=0),
+        MetricAverageCallback(),
+        LearningRateWarmupCallback(initial_lr=0.1, multiplier=4.0,
+                                   warmup_epochs=1, steps_per_epoch=10),
+    ])
+    cbs.on_train_begin(state)
+    assert state["lr"] == 0.1
+    for b in range(10):
+        cbs.on_batch_end(b, state)
+    assert abs(state["lr"] - 0.4) < 1e-9  # fully warmed: 0.1 * 4
+    cbs.on_epoch_end(0, state)
+    assert abs(state["metrics"]["loss"] - 3.0) < 1e-6  # collective mode: id
+
+
+def test_warmup_schedule(rng):
+    bps.init()
+    sched = warmup_schedule(0.01, multiplier=8.0, warmup_steps=100)
+    assert abs(float(sched(0)) - 0.01) < 1e-9
+    assert abs(float(sched(100)) - 0.08) < 1e-7
+    assert abs(float(sched(500)) - 0.08) < 1e-7
+    mid = float(sched(50))
+    assert 0.01 < mid < 0.08
