@@ -1,0 +1,126 @@
+"""Synthetic-data training throughput benchmark.
+
+Reference analogue: example/pytorch/benchmark_byteps.py (SURVEY.md §2.6)
+— the reference's headline benchmark harness: synthetic ImageNet batches
+through ResNet-50/VGG-16 (or synthetic token batches through BERT/GPT),
+reporting images|sequences per second. Run single-process, or multi-worker
+under bpslaunch with a PS topology:
+
+    python example/jax/benchmark_byteps.py --model resnet50
+    python -m byteps_tpu.launcher --local 2 --num-servers 1 -- \
+        python example/jax/benchmark_byteps.py --model resnet50
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50",
+                   choices=["resnet18", "resnet50", "vgg16", "bert_base",
+                            "bert_large", "gpt2"])
+    p.add_argument("--batch-size", type=int, default=0,
+                   help="global batch (default: model-appropriate per chip)")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--num-iters", type=int, default=20)
+    p.add_argument("--num-warmup", type=int, default=3)
+    p.add_argument("--fp32", action="store_true",
+                   help="float32 weights (default bfloat16)")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import byteps_tpu.jax as bps
+    from byteps_tpu.jax.training import (make_train_step, replicate,
+                                         shard_batch)
+    from byteps_tpu.models import (GPT2Small, BertBase, BertLarge, ResNet18,
+                                   ResNet50, VGG16, lm_loss, masked_lm_loss)
+    from byteps_tpu.jax.flax_util import cross_entropy_loss
+
+    bps.init()
+    n_dev = bps.device_count()
+    dtype = jnp.float32 if args.fp32 else jnp.bfloat16
+    rng = np.random.default_rng(0)
+    is_lm = args.model in ("bert_base", "bert_large", "gpt2")
+
+    if is_lm:
+        model = {"bert_base": BertBase, "bert_large": BertLarge,
+                 "gpt2": GPT2Small}[args.model](dtype=dtype)
+        batch = args.batch_size or 8 * n_dev
+        toks = jnp.asarray(rng.integers(0, 1000, (batch, args.seq_len)),
+                           jnp.int32)
+        mask = jnp.asarray(rng.integers(0, 2, (batch, args.seq_len)),
+                           jnp.int32)
+        data = (toks, mask)
+        params = model.init(jax.random.PRNGKey(0), toks[:1])
+
+        if args.model == "gpt2":
+            def loss_fn(p, b):
+                return lm_loss(model.apply(p, b[0]), b[0])
+        else:
+            def loss_fn(p, b):
+                return masked_lm_loss(model.apply(p, b[0]), b[0], b[1])
+        unit = "sequences/sec"
+    else:
+        model = {"resnet18": ResNet18, "resnet50": ResNet50,
+                 "vgg16": VGG16}[args.model](num_classes=1000, dtype=dtype)
+        batch = args.batch_size or 32 * n_dev
+        x = jnp.asarray(rng.standard_normal(
+            (batch, args.image_size, args.image_size, 3)), jnp.float32)
+        y = jnp.asarray(rng.integers(0, 1000, batch), jnp.int32)
+        data = (x, y)
+        if args.model == "vgg16":
+            params = model.init(jax.random.PRNGKey(0), x[:1])
+
+            def loss_fn(p, b):
+                return cross_entropy_loss(model.apply(p, b[0]), b[1])
+        else:
+            # BatchNorm models go through the flax train step
+            from byteps_tpu.jax.flax_util import make_flax_train_step
+            variables = model.init(jax.random.PRNGKey(0), x[:1], train=False)
+            tx = optax.sgd(0.1, momentum=0.9)
+            step = make_flax_train_step(model.apply, tx, bps.mesh())
+            state = (replicate(variables["params"]),
+                     replicate(variables["batch_stats"]),
+                     replicate(tx.init(variables["params"])))
+            run_benchmark(step, state, shard_batch(data), batch, args)
+            return
+        unit = "images/sec"
+
+    tx = optax.sgd(0.1, momentum=0.9) if not is_lm else optax.adamw(1e-4)
+    step = make_train_step(loss_fn, tx, bps.mesh())
+    state = (replicate(params), replicate(tx.init(params)))
+    run_benchmark(step, state, shard_batch(data), batch, args)
+
+
+def run_benchmark(step, state, batch_parts, batch, args) -> None:
+    import jax
+
+    import byteps_tpu.jax as bps
+
+    state = step(*state, batch_parts)
+    for _ in range(args.num_warmup - 1):
+        state = step(*state[:-1], batch_parts)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        state = step(*state[:-1], batch_parts)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    ips = batch * args.num_iters / dt
+    if bps.rank() == 0:
+        print(f"Model: {args.model}")
+        print(f"Batch size: {batch} ({bps.device_count()} chips)")
+        print(f"Iter throughput: {ips:.1f} items/sec "
+              f"({ips / bps.device_count():.1f} per chip)")
+
+
+if __name__ == "__main__":
+    main()

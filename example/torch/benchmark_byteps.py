@@ -1,0 +1,71 @@
+"""Synthetic-data benchmark for the byteps_tpu.torch plugin (CPU torch).
+
+Reference analogue: example/pytorch/benchmark_byteps.py run through the
+torch plugin's DistributedOptimizer. Launch under a PS topology:
+
+    python -m byteps_tpu.launcher --local 2 --num-servers 1 -- \
+        python example/torch/benchmark_byteps.py --num-iters 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--hidden", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=8)
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--num-warmup", type=int, default=2)
+    p.add_argument("--fp16-wire", action="store_true",
+                   help="fp16 wire compression for the push/pull stage")
+    args = p.parse_args()
+
+    import torch
+
+    import byteps_tpu.torch as bps
+
+    bps.init()
+    torch.manual_seed(0)
+    layers = []
+    for i in range(args.layers):
+        layers += [torch.nn.Linear(args.hidden, args.hidden),
+                   torch.nn.ReLU()]
+    model = torch.nn.Sequential(*layers, torch.nn.Linear(args.hidden, 10))
+    bps.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    compression = (bps.Compression.fp16 if args.fp16_wire
+                   else bps.Compression.none)
+    opt = bps.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01),
+        named_parameters=model.named_parameters(),
+        compression=compression)
+
+    x = torch.randn(args.batch_size, args.hidden)
+    y = torch.randint(0, 10, (args.batch_size,))
+    loss_fn = torch.nn.CrossEntropyLoss()
+
+    def one_iter():
+        opt.zero_grad()
+        loss_fn(model(x), y).backward()
+        opt.step()
+
+    for _ in range(args.num_warmup):
+        one_iter()
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        one_iter()
+    dt = time.perf_counter() - t0
+    ips = args.batch_size * args.num_iters / dt
+    if bps.rank() == 0:
+        n_params = sum(p.numel() for p in model.parameters())
+        print(f"workers: {bps.size()}, params: {n_params / 1e6:.1f}M, "
+              f"wire: {'fp16' if args.fp16_wire else 'fp32'}")
+        print(f"throughput: {ips:.1f} samples/sec/worker")
+
+
+if __name__ == "__main__":
+    main()

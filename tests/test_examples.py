@@ -1,0 +1,75 @@
+"""Examples-as-smoke-tests (reference test strategy, SURVEY.md §4:
+example scripts double as CI smoke tests)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EX = os.path.join(REPO, "example")
+
+
+def _run(script, *cli, extra_env=None, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8")
+    env.update(extra_env or {})
+    out = subprocess.run([sys.executable, script, *cli], env=env,
+                         capture_output=True, text=True, timeout=timeout)
+    assert out.returncode == 0, out.stdout + out.stderr
+    return out.stdout
+
+
+def test_benchmark_resnet18_smoke():
+    out = _run(os.path.join(EX, "jax", "benchmark_byteps.py"),
+               "--model", "resnet18", "--batch-size", "8",
+               "--image-size", "32", "--num-iters", "2", "--num-warmup", "1",
+               "--fp32")
+    assert "Iter throughput" in out
+
+
+def test_benchmark_gpt2_smoke():
+    out = _run(os.path.join(EX, "jax", "benchmark_byteps.py"),
+               "--model", "gpt2", "--batch-size", "8", "--seq-len", "16",
+               "--num-iters", "2", "--num-warmup", "1", "--fp32")
+    assert "Iter throughput" in out
+
+
+def test_mnist_example(tmp_path):
+    out = _run(os.path.join(EX, "jax", "mnist_byteps.py"),
+               "--epochs", "2", "--batch-size", "512",
+               "--ckpt-dir", str(tmp_path / "ck"))
+    assert "train accuracy" in out
+    # the synthetic task is separable; training must actually learn
+    acc = float(out.strip().split("train accuracy:")[-1])
+    assert acc > 0.5, out
+
+
+def test_imagenet_style_example(tmp_path):
+    out = _run(os.path.join(EX, "jax", "train_imagenet_resnet50_byteps.py"),
+               "--steps", "3", "--batch-size", "8", "--image-size", "64",
+               "--ckpt-every", "2", "--ckpt-dir", str(tmp_path / "ck"))
+    assert "step 0" in out
+    assert os.path.isdir(str(tmp_path / "ck"))
+
+
+@pytest.mark.ps
+def test_torch_benchmark_under_launcher():
+    from tests.ps_utils import free_port
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DMLC_PS_ROOT_PORT"] = str(free_port())
+    out = subprocess.run(
+        [sys.executable, "-m", "byteps_tpu.launcher", "--local", "2",
+         "--num-servers", "1", "--",
+         sys.executable, os.path.join(EX, "torch", "benchmark_byteps.py"),
+         "--num-iters", "3", "--layers", "2", "--hidden", "256"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "throughput" in out.stdout
