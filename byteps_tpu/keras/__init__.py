@@ -1,0 +1,59 @@
+"""byteps_tpu.keras — Keras framework plugin (Horovod-compatible API).
+
+Capability parity: reference byteps/keras/__init__.py (SURVEY.md §2.5):
+``init`` / ``rank`` / ``size`` etc. re-exported from the TensorFlow
+plugin, ``DistributedOptimizer`` usable directly in ``model.compile``,
+``broadcast_global_variables``, and the callback set in
+``byteps_tpu.keras.callbacks``.
+
+    import byteps_tpu.keras as bps
+    bps.init()
+    model.compile(optimizer=bps.DistributedOptimizer(keras.optimizers.SGD(
+        learning_rate=0.01 * bps.size())), loss=..., metrics=[...])
+    model.fit(dataset,
+              callbacks=[bps.callbacks.BroadcastGlobalVariablesCallback(0),
+                         bps.callbacks.MetricAverageCallback()])
+"""
+
+from __future__ import annotations
+
+from byteps_tpu.tensorflow import (  # noqa: F401
+    Compression,
+    DistributedOptimizer,
+    broadcast,
+    broadcast_variables,
+    init,
+    initialized,
+    local_rank,
+    local_size,
+    push_pull,
+    rank,
+    shutdown,
+    size,
+)
+
+from byteps_tpu.keras import callbacks  # noqa: F401  (after bps exports)
+
+__all__ = [
+    "init", "shutdown", "initialized", "rank", "size", "local_rank",
+    "local_size", "push_pull", "broadcast", "broadcast_variables",
+    "broadcast_global_variables", "DistributedOptimizer", "Compression",
+    "callbacks",
+]
+
+
+def broadcast_global_variables(root_rank: int = 0) -> None:
+    """Broadcast every TF global variable from ``root_rank`` (reference:
+    keras broadcast_global_variables — TF1-session flavour). With TF2
+    eager there is no global collection; prefer
+    ``broadcast_variables(model.variables)`` or the
+    BroadcastGlobalVariablesCallback."""
+    import tensorflow as tf
+
+    v1_vars = tf.compat.v1.global_variables()
+    if not v1_vars:
+        raise RuntimeError(
+            "no tf.compat.v1 global variables exist (TF2 eager mode); "
+            "use broadcast_variables(model.variables, root_rank) or the "
+            "BroadcastGlobalVariablesCallback instead")
+    broadcast_variables(v1_vars, root_rank=root_rank)

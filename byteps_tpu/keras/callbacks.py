@@ -1,0 +1,144 @@
+"""Keras callbacks for byteps_tpu (Horovod-compatible names/semantics).
+
+Capability parity: reference byteps/keras/callbacks.py +
+byteps/tensorflow/keras/callbacks.py (SURVEY.md §2.5):
+``BroadcastGlobalVariablesCallback``, ``MetricAverageCallback``,
+``LearningRateWarmupCallback``, ``LearningRateScheduleCallback`` — real
+``keras.callbacks.Callback`` subclasses that plug into ``model.fit``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import tensorflow as tf
+
+import byteps_tpu.tensorflow as bps
+
+_KerasCallback = tf.keras.callbacks.Callback
+
+
+class BroadcastGlobalVariablesCallback(_KerasCallback):
+    """Broadcast all model/optimizer variables from ``root_rank`` at the
+    start of training so every worker begins from identical state
+    (reference: keras BroadcastGlobalVariablesCallback)."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_train_begin(self, logs=None):
+        if self._done or bps.size() <= 1:
+            return
+        model_vars = list(getattr(self.model, "variables", []) or [])
+        opt = getattr(self.model, "optimizer", None)
+        opt_vars = list(getattr(opt, "variables", []) or []) if opt else []
+        seen = set()
+        to_sync = []
+        for v in model_vars + opt_vars:
+            if id(v) not in seen and hasattr(v, "assign"):
+                seen.add(id(v))
+                to_sync.append(v)
+        bps.broadcast_variables(to_sync, root_rank=self.root_rank)
+        self._done = True
+
+
+class MetricAverageCallback(_KerasCallback):
+    """Average epoch metrics over all workers before other callbacks
+    (checkpointing, early stopping, logging) read them (reference: keras
+    MetricAverageCallback). Place it before those callbacks in the list."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs or bps.size() <= 1:
+            return
+        for k in sorted(logs):
+            v = logs[k]
+            if isinstance(v, (int, float)):
+                logs[k] = float(bps.push_pull(
+                    tf.constant(float(v)), average=True,
+                    name=f"metric.{k}").numpy())
+
+
+class LearningRateScheduleCallback(_KerasCallback):
+    """Multiply the optimizer LR by ``multiplier`` (a constant or a
+    function of epoch) within [start_epoch, end_epoch) (reference: keras
+    LearningRateScheduleCallback)."""
+
+    def __init__(self, initial_lr: float,
+                 multiplier,
+                 start_epoch: int = 0,
+                 end_epoch: Optional[int] = None,
+                 staircase: bool = True,
+                 steps_per_epoch: Optional[int] = None):
+        super().__init__()
+        self.initial_lr = float(initial_lr)
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self._current_epoch = 0
+        if callable(multiplier):
+            self._mult: Callable[[float], float] = multiplier
+            self._constant = None
+        else:
+            self._constant = float(multiplier)
+            self._mult = lambda epoch: self._constant
+
+    def _in_window(self, epoch: float) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def _set_lr(self, epoch: float) -> None:
+        if not self._in_window(epoch):
+            return
+        lr = self.initial_lr * self._mult(epoch)
+        opt = self.model.optimizer
+        # Keras 3: .learning_rate variable; Keras 2 legacy: .lr
+        target = getattr(opt, "learning_rate", None)
+        if target is None:
+            target = getattr(opt, "lr")
+        if hasattr(target, "assign"):
+            target.assign(lr)
+        else:
+            opt.learning_rate = lr
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._current_epoch = epoch
+        if self.staircase:
+            self._set_lr(epoch)
+
+    def on_batch_begin(self, batch, logs=None):
+        if not self.staircase and self.steps_per_epoch:
+            self._set_lr(self._current_epoch +
+                         batch / float(self.steps_per_epoch))
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Horovod's gradual LR warmup (reference: keras
+    LearningRateWarmupCallback): ramp from ``initial_lr`` to
+    ``initial_lr * multiplier`` (default: worker count, the linear-scaling
+    rule) over ``warmup_epochs`` epochs, smoothly per batch."""
+
+    def __init__(self, initial_lr: float,
+                 multiplier: Optional[float] = None,
+                 warmup_epochs: int = 5,
+                 steps_per_epoch: Optional[int] = None,
+                 verbose: bool = False):
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+        mult = float(multiplier if multiplier is not None else bps.size())
+
+        def warmup_mult(epoch: float) -> float:
+            frac = min(1.0, (epoch + 1.0) / max(1, self.warmup_epochs))
+            return 1.0 + frac * (mult - 1.0)
+
+        super().__init__(initial_lr, warmup_mult, start_epoch=0,
+                         end_epoch=warmup_epochs, staircase=False,
+                         steps_per_epoch=steps_per_epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose and epoch == self.warmup_epochs - 1:
+            print(f"warmup complete: lr -> "
+                  f"{float(tf.keras.backend.get_value(self.model.optimizer.learning_rate)):.6g}")

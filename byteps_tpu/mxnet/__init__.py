@@ -1,0 +1,161 @@
+"""byteps_tpu.mxnet — MXNet framework plugin (Horovod-compatible API).
+
+Capability parity target: reference byteps/mxnet (SURVEY.md §2.5): ``init``
+/ ``rank`` / ``size``, ``byteps_push_pull(NDArray)``,
+``DistributedTrainer`` (a ``gluon.Trainer`` whose ``_allreduce_grads``
+push_pulls through the PS core), ``broadcast_parameters``.
+
+MXNet is not installed in this environment (it is long past end-of-life
+and absent from the image), so this module gates on import: the API is
+implemented against MXNet's stable NDArray/gluon surface and raises a
+clear ImportError when mxnet is missing rather than failing obscurely.
+The transport underneath is byteps_tpu's C++ PS core, shared with the
+torch/tensorflow plugins.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+try:
+    import mxnet as mx
+    from mxnet import gluon
+except ImportError as _e:  # pragma: no cover - environment-dependent
+    raise ImportError(
+        "byteps_tpu.mxnet requires the 'mxnet' package, which is not "
+        "installed in this environment. The JAX (byteps_tpu.jax), PyTorch "
+        "(byteps_tpu.torch), TensorFlow (byteps_tpu.tensorflow) and Keras "
+        "(byteps_tpu.keras) plugins provide the same Horovod-compatible "
+        "API surface.") from _e
+
+import numpy as np
+
+from byteps_tpu.config import Config, get_config
+
+__all__ = [
+    "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "byteps_push_pull", "broadcast_parameters", "DistributedTrainer",
+]
+
+_client = None
+_cfg: Optional[Config] = None
+_declared = {}            # name -> (tensor_id, nelem, dtype_name)
+_noname_seq = 0
+
+
+def init(config: Optional[Config] = None) -> None:
+    """Initialise the plugin (reference: byteps.mxnet.init)."""
+    global _client, _cfg
+    if _cfg is not None:
+        return
+    _cfg = config or get_config(reload=True)
+    if _cfg.distributed:
+        from byteps_tpu.core import ffi as _ffi
+        _client = _ffi.Worker.start(_cfg)
+
+
+def shutdown() -> None:
+    global _client, _cfg, _noname_seq
+    if _client is not None:
+        _client.shutdown()
+        _client = None
+    _declared.clear()
+    _noname_seq = 0
+    _cfg = None
+
+
+def rank() -> int:
+    return _client.worker_rank() if _client is not None else 0
+
+
+def size() -> int:
+    return _client.num_workers() if _client is not None else 1
+
+
+def local_rank() -> int:
+    return _cfg.local_rank if _cfg else 0
+
+
+def local_size() -> int:
+    return _cfg.local_size if _cfg else 1
+
+
+def _declare(name: str, nelem: int, dtype) -> int:
+    dt = np.dtype(dtype).name
+    cached = _declared.get(name)
+    if cached is not None:
+        tid, n0, d0 = cached
+        if (n0, d0) != (nelem, dt):
+            raise ValueError(f"tensor {name!r} re-declared with different "
+                             f"shape/dtype ({n0},{d0}) vs ({nelem},{dt})")
+        return tid
+    tid = _client.declare(name, nelem, dt)
+    _declared[name] = (tid, nelem, dt)
+    return tid
+
+
+def _auto_name() -> str:
+    """Per-call sequential fallback name (reference/Horovod:
+    push_pull.noname.N) — correct when all ranks issue unnamed calls in
+    lockstep order. Never keyed on id(): CPython reuses object ids, which
+    would resurrect a stale declaration."""
+    global _noname_seq
+    name = f"byteps.mx.noname.{_noname_seq}"
+    _noname_seq += 1
+    return name
+
+
+def byteps_push_pull(tensor, version: int = 0, priority: int = 0,
+                     name: Optional[str] = None,
+                     is_average: bool = True) -> None:
+    """In-place sum (or average) of an NDArray across workers (reference:
+    byteps.mxnet.byteps_push_pull → MXEnginePushAsync + EnqueueTensor).
+    Synchronous here: MXNet's async engine is not in the loop, the PS
+    pipeline itself provides the overlap."""
+    if _client is None:
+        return
+    arr = tensor.asnumpy().reshape(-1)
+    tid = _declare(name or _auto_name(), arr.size, arr.dtype)
+    _client.wait(_client.push_pull(tid, arr, average=is_average))
+    tensor[:] = mx.nd.array(arr.reshape(tensor.shape), dtype=arr.dtype)
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Sync a gluon ParameterDict (or dict of NDArrays) from root
+    (reference: byteps.mxnet.broadcast_parameters)."""
+    if _client is None:
+        return
+    if hasattr(params, "items"):
+        named = sorted(params.items())
+    else:
+        named = sorted(enumerate(params))
+    for name, p in named:
+        nd = p.data() if hasattr(p, "data") else p
+        arr = nd.asnumpy().reshape(-1)
+        tid = _declare(f"bcast.{name}", arr.size, arr.dtype)
+        _client.wait(_client.broadcast(tid, arr, root_rank=root_rank))
+        nd[:] = mx.nd.array(arr.reshape(nd.shape), dtype=arr.dtype)
+
+
+class DistributedTrainer(gluon.Trainer):
+    """gluon.Trainer whose gradient reduction goes through the PS core
+    (reference: byteps.mxnet.DistributedTrainer overriding
+    _allreduce_grads; LR is rescaled so the server-side sum plus local
+    scale equals a true average)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 root_rank: int = 0):
+        super().__init__(params, optimizer, optimizer_params,
+                         kvstore=None)
+        self._bps_root = root_rank
+        self._scale /= size()
+
+    def _allreduce_grads(self) -> None:
+        if size() <= 1:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                for grad in param.list_grad():
+                    byteps_push_pull(grad, priority=-i,
+                                     name=f"grad.{i}.{param.name}",
+                                     is_average=False)

@@ -1,0 +1,346 @@
+"""byteps_tpu.tensorflow — TensorFlow framework plugin (Horovod-compatible).
+
+Capability parity with the reference's byteps/tensorflow plugin (SURVEY.md
+§2.5 and §3.5): ``init`` / ``shutdown`` / ``rank`` / ``size`` /
+``local_rank`` / ``local_size``, ``push_pull`` (works eagerly and inside
+``tf.function`` graphs), ``broadcast`` / ``broadcast_variables``,
+``DistributedOptimizer`` (wraps ``apply_gradients``, and
+``compute_gradients`` for tf.compat.v1 optimizers),
+``DistributedGradientTape`` for TF2 custom training loops, and
+``BroadcastGlobalVariablesHook``-equivalent callbacks (byteps_tpu.keras).
+
+Transport: the byteps_tpu C++ core (TCP van → CPU-summation parameter
+servers), the same path the torch plugin uses. The reference's custom op
+kernels ("BytepsPushPull", byteps/tensorflow/ops.cc) become
+``tf.numpy_function`` nodes whose eager body hands zero-copy numpy views
+to the C core — no TF custom-op build step needed.
+
+Single-process mode (no scheduler configured): all collective calls
+degrade to local no-ops so scripts run unmodified, matching the
+reference's non-distributed fallback.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Iterable, Optional
+
+import numpy as np
+import tensorflow as tf
+
+from byteps_tpu.config import Config, get_config
+from byteps_tpu.tensorflow.compression import Compression
+
+__all__ = [
+    "init", "shutdown", "initialized", "rank", "size", "local_rank",
+    "local_size", "push_pull", "broadcast", "broadcast_variables",
+    "DistributedOptimizer", "DistributedGradientTape", "Compression",
+]
+
+_lock = threading.Lock()
+_client = None            # core.ffi.Worker in distributed mode
+_cfg: Optional[Config] = None
+_initialized = False
+_declared = {}            # name -> (tensor_id, nelem, dtype_name)
+_noname_seq = 0
+
+
+def init(config: Optional[Config] = None) -> None:
+    """Initialise the plugin (reference: bps.init() → byteps_init)."""
+    global _client, _cfg, _initialized
+    with _lock:
+        if _initialized:
+            return
+        _cfg = config or get_config(reload=True)
+        if _cfg.distributed:
+            from byteps_tpu.core import ffi as _ffi
+            _client = _ffi.Worker.start(_cfg)
+        _initialized = True
+
+
+def shutdown() -> None:
+    """Tear down (reference: byteps_shutdown)."""
+    global _client, _initialized, _noname_seq
+    with _lock:
+        if _client is not None:
+            _client.shutdown()
+            _client = None
+        _declared.clear()
+        _noname_seq = 0
+        _initialized = False
+
+
+def initialized() -> bool:
+    return _initialized
+
+
+def _require_init() -> None:
+    if not _initialized:
+        raise RuntimeError("byteps_tpu.tensorflow.init() has not been "
+                           "called")
+
+
+def rank() -> int:
+    """This worker process's rank in [0, size())."""
+    _require_init()
+    return _client.worker_rank() if _client is not None else 0
+
+
+def size() -> int:
+    """Number of worker processes (the gradient-averaging denominator)."""
+    _require_init()
+    return _client.num_workers() if _client is not None else 1
+
+
+def local_rank() -> int:
+    _require_init()
+    return _cfg.local_rank
+
+
+def local_size() -> int:
+    _require_init()
+    return _cfg.local_size
+
+
+# --- tensor plumbing --------------------------------------------------------
+
+def _auto_name() -> str:
+    """Sequential fallback name (reference/Horovod: BytePSPushPull.noname.N).
+    Correct when all ranks issue unnamed calls in lockstep order."""
+    global _noname_seq
+    name = f"byteps_tpu.tf.noname.{_noname_seq}"
+    _noname_seq += 1
+    return name
+
+
+def _declare(name: str, nelem: int, np_dtype) -> int:
+    dt = np.dtype(np_dtype).name
+    cached = _declared.get(name)
+    if cached is not None:
+        tid, n0, d0 = cached
+        if (n0, d0) != (nelem, dt):
+            raise ValueError(f"tensor {name!r} re-declared with different "
+                             f"shape/dtype ({n0},{d0}) vs ({nelem},{dt})")
+        return tid
+    tid = _client.declare(name, nelem, dt)
+    _declared[name] = (tid, nelem, dt)
+    return tid
+
+
+def _push_pull_numpy(arr: np.ndarray, average: bool, name: str) -> np.ndarray:
+    """Eager body of the push_pull op: hand a flat buffer to the C core,
+    wait, return the summed buffer. Runs on the host — exactly where the
+    reference's kernel enqueues into the core pipeline (ops.cc
+    BytepsPushPullOp::ComputeAsync). The core sums IN PLACE, and on CPU
+    ``tf.Tensor.numpy()`` / ``tf.numpy_function`` inputs can alias the
+    tensor's own storage, so copy first — push_pull must not mutate its
+    input."""
+    flat = np.array(arr, copy=True).reshape(-1)
+    tid = _declare(name, flat.size, flat.dtype)
+    h = _client.push_pull(tid, flat, average=average,
+                          async_mode=_cfg.enable_async)
+    _client.wait(h)
+    return flat.reshape(arr.shape)
+
+
+def push_pull(tensor, average: bool = True, name: Optional[str] = None,
+              compression=Compression.none):
+    """Sum (or average) ``tensor`` across all workers; returns the result.
+    Reference: byteps.tensorflow.push_pull (ops.py _push_pull). Works both
+    eagerly and inside a ``tf.function``: in a traced graph the exchange
+    becomes a ``tf.numpy_function`` node running the same eager body.
+
+    ``tf.IndexedSlices`` (embedding gradients) are densified first, like
+    the reference/Horovod.
+    """
+    _require_init()
+    tensor = tf.convert_to_tensor(tensor)  # densifies tf.IndexedSlices too
+    if _client is None:
+        return tensor
+    nm = name or _auto_name()
+    wire, ctx = compression.compress(tensor)
+
+    def _body(arr):
+        return _push_pull_numpy(arr, average, nm)
+
+    if tf.executing_eagerly():
+        out = tf.convert_to_tensor(_body(wire.numpy()))
+    else:
+        out = tf.numpy_function(_body, [wire], Tout=wire.dtype,
+                                name="BytepsPushPull")
+        out.set_shape(wire.shape)
+    return compression.decompress(out, ctx)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    """Value broadcast from ``root_rank`` (reference: BytepsBroadcast op).
+    Returns a tensor equal to root's value on every worker."""
+    _require_init()
+    tensor = tf.convert_to_tensor(tensor)
+    if _client is None:
+        return tensor
+    nm = name or _auto_name()
+
+    def _body(arr):
+        # copy: the core writes root's value in place (see _push_pull_numpy)
+        flat = np.array(arr, copy=True).reshape(-1)
+        tid = _declare(nm, flat.size, flat.dtype)
+        _client.wait(_client.broadcast(tid, flat, root_rank=root_rank))
+        return flat.reshape(arr.shape)
+
+    if tf.executing_eagerly():
+        return tf.convert_to_tensor(_body(tensor.numpy()))
+    out = tf.numpy_function(_body, [tensor], Tout=tensor.dtype,
+                            name="BytepsBroadcast")
+    out.set_shape(tensor.shape)
+    return out
+
+
+def broadcast_variables(variables: Iterable, root_rank: int = 0) -> None:
+    """Assign every variable its ``root_rank`` value, in place (reference:
+    broadcast_variables / BroadcastGlobalVariablesHook body). Use after
+    building the model so all workers start from identical weights."""
+    _require_init()
+    if _client is None:
+        return
+    for i, v in enumerate(variables):
+        # v.name alone is not unique (unnamed Variables all report
+        # "Variable:0"), so key on position too — iteration order is the
+        # lockstep contract, as in the reference's noname sequence.
+        name = getattr(v, "name", None) or "var"
+        v.assign(broadcast(v, root_rank=root_rank,
+                           name=f"bcast.{i}.{name}"))
+
+
+# --- gradient integration ---------------------------------------------------
+
+def _var_key(v, i: int) -> str:
+    """Wire key for a gradient: the variable's name when it has one (as in
+    the reference/Horovod — keeps two wrapped optimizers in one process
+    from colliding), with position for unnamed variables."""
+    name = getattr(v, "path", None) or getattr(v, "name", None)
+    return f"grad.{name}" if name else f"grad.pos.{i}"
+
+
+def _push_pull_grads(grads, variables, compression):
+    """push_pull each gradient (None entries pass through untouched)."""
+    out = []
+    for i, (g, v) in enumerate(zip(grads, variables)):
+        if g is None:
+            out.append(None)
+            continue
+        out.append(push_pull(g, average=True, name=_var_key(v, i),
+                             compression=compression))
+    return out
+
+
+class DistributedGradientTape:
+    """TF2 custom-training-loop integration (reference:
+    byteps/tensorflow/__init__.py DistributedGradientTape): wraps a
+    ``tf.GradientTape`` so ``gradient()`` returns push_pull-averaged
+    gradients.
+
+        with bps.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = loss_fn(model(x))
+        grads = tape.gradient(loss, model.trainable_variables)
+    """
+
+    def __init__(self, tape: tf.GradientTape,
+                 compression=Compression.none):
+        self._tape = tape
+        self._compression = compression
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return self._tape.__exit__(exc_type, exc, tb)
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        if size() <= 1:
+            return grads
+        flat = _push_pull_grads(tf.nest.flatten(grads),
+                                tf.nest.flatten(sources),
+                                self._compression)
+        return tf.nest.pack_sequence_as(grads, flat)
+
+
+def DistributedOptimizer(optimizer, compression=Compression.none,
+                         backward_passes_per_step: int = 1):
+    """Wrap a TF optimizer for data-parallel training (reference:
+    byteps.tensorflow.DistributedOptimizer).
+
+    - Keras (2/3) optimizers: ``apply_gradients`` (and Keras 3 ``apply``)
+      push_pull-average the gradients before the update.
+    - tf.compat.v1 optimizers: ``compute_gradients`` returns averaged
+      gradients, matching the reference's TF1 wrap.
+
+    Returns an object of a dynamically created subclass of ``optimizer``'s
+    class, so isinstance checks and LR schedules keep working.
+    """
+    if backward_passes_per_step != 1:
+        raise ValueError(
+            "backward_passes_per_step > 1 is not supported by the TF "
+            "plugin; accumulate gradients in the training loop instead")
+    _require_init()
+
+    base = optimizer.__class__
+    is_v1 = isinstance(optimizer, tf.compat.v1.train.Optimizer)
+
+    if is_v1:
+        class _Wrapped(base):  # type: ignore[valid-type, misc]
+            def compute_gradients(self, *args, **kwargs):
+                gradvars = super().compute_gradients(*args, **kwargs)
+                if size() <= 1:
+                    return gradvars
+                grads = _push_pull_grads([g for g, _ in gradvars],
+                                         [v for _, v in gradvars],
+                                         compression)
+                return list(zip(grads, [v for _, v in gradvars]))
+    else:
+        class _Wrapped(base):  # type: ignore[valid-type, misc]
+            # Keras 3's apply_gradients delegates to apply(); the flag
+            # keeps the nested call from communicating a second time.
+            _bps_in_flight = False
+
+            def apply_gradients(self, grads_and_vars, *args, **kwargs):
+                grads_and_vars = list(grads_and_vars)
+                if size() > 1 and not self._bps_in_flight:
+                    grads = _push_pull_grads(
+                        [g for g, _ in grads_and_vars],
+                        [v for _, v in grads_and_vars], compression)
+                    grads_and_vars = list(
+                        zip(grads, [v for _, v in grads_and_vars]))
+                self._bps_in_flight = True
+                try:
+                    return super().apply_gradients(grads_and_vars, *args,
+                                                   **kwargs)
+                finally:
+                    self._bps_in_flight = False
+
+            def apply(self, grads, trainable_variables=None, **kwargs):
+                if size() > 1 and not self._bps_in_flight:
+                    grads = list(grads)
+                    tvars = (list(trainable_variables)
+                             if trainable_variables is not None else
+                             list(getattr(self, "_trainable_variables",
+                                          None) or [None] * len(grads)))
+                    grads = _push_pull_grads(grads, tvars, compression)
+                self._bps_in_flight = True
+                try:
+                    if trainable_variables is None:
+                        return super().apply(grads, **kwargs)
+                    return super().apply(grads, trainable_variables,
+                                         **kwargs)
+                finally:
+                    self._bps_in_flight = False
+
+    _Wrapped.__name__ = "Distributed" + base.__name__
+    wrapped = _Wrapped.__new__(_Wrapped)
+    wrapped.__dict__.update(optimizer.__dict__)
+    return wrapped

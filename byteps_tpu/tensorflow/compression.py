@@ -1,0 +1,46 @@
+"""Wire-level gradient compression for the TensorFlow plugin.
+
+Capability parity: reference byteps/tensorflow/compression.py (SURVEY.md
+§2.5) — the Horovod-compatible ``Compression`` namespace: ``none`` and
+``fp16``, applied to each tensor before communication and undone after.
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+
+class NoneCompressor:
+    """No-op compression (reference: Compression.none)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor:
+    """Cast to float16 for the wire, cast back after (reference:
+    Compression.fp16). Halves DCN bytes; the server sums in fp16."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype in (tf.float32, tf.float64):
+            return tf.cast(tensor, tf.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None:
+            return tf.cast(tensor, ctx)
+        return tensor
+
+
+class Compression:
+    """Namespace of wire compressors (Horovod-compatible)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor

@@ -90,17 +90,19 @@ def main() -> None:
             state = (replicate(variables["params"]),
                      replicate(variables["batch_stats"]),
                      replicate(tx.init(variables["params"])))
-            run_benchmark(step, state, shard_batch(data), batch, args)
+            run_benchmark(step, state, shard_batch(data), batch, args,
+                          unit="images/sec")
             return
         unit = "images/sec"
 
     tx = optax.sgd(0.1, momentum=0.9) if not is_lm else optax.adamw(1e-4)
     step = make_train_step(loss_fn, tx, bps.mesh())
     state = (replicate(params), replicate(tx.init(params)))
-    run_benchmark(step, state, shard_batch(data), batch, args)
+    run_benchmark(step, state, shard_batch(data), batch, args, unit)
 
 
-def run_benchmark(step, state, batch_parts, batch, args) -> None:
+def run_benchmark(step, state, batch_parts, batch, args,
+                  unit: str = "items/sec") -> None:
     import jax
 
     import byteps_tpu.jax as bps
@@ -118,7 +120,7 @@ def run_benchmark(step, state, batch_parts, batch, args) -> None:
     if bps.rank() == 0:
         print(f"Model: {args.model}")
         print(f"Batch size: {batch} ({bps.device_count()} chips)")
-        print(f"Iter throughput: {ips:.1f} items/sec "
+        print(f"Iter throughput: {ips:.1f} {unit} "
               f"({ips / bps.device_count():.1f} per chip)")
 
 

@@ -1,0 +1,171 @@
+"""Worker-side assertions for the TensorFlow-plugin topology tests.
+
+One process per worker rank, mode via BPS_TEST_MODE — the reference's
+tests/test_tensorflow.py under run_byteps_test.sh pattern (SURVEY.md §4).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+import numpy as np
+import tensorflow as tf
+
+import byteps_tpu.tensorflow as bps
+
+
+def main() -> int:
+    mode = os.environ.get("BPS_TEST_MODE", "push_pull")
+    bps.init()
+    rank, nw = bps.rank(), bps.size()
+    rng = np.random.default_rng(1234)  # same stream on all workers
+
+    try:
+        if mode == "push_pull":
+            for shape, dtype in [((64,), np.float32), ((13, 5), np.float32),
+                                 ((128,), np.float64), ((16,), np.int64)]:
+                base = rng.standard_normal(shape)
+                x = tf.constant((base * (rank + 1)).astype(dtype))
+                x0 = x.numpy().copy()
+                out = bps.push_pull(x, average=False,
+                                    name=f"t_{shape}_{np.dtype(dtype).name}")
+                expect = sum((base * (r + 1)).astype(dtype).astype(np.float64)
+                             for r in range(nw))
+                np.testing.assert_allclose(out.numpy().astype(np.float64),
+                                           expect, rtol=1e-5, atol=1e-8)
+                # input tensor unchanged (the core sums in place on a copy)
+                np.testing.assert_array_equal(x.numpy(), x0)
+
+            # average
+            y = tf.fill((50,), float(rank + 1))
+            out = bps.push_pull(y, average=True, name="avg")
+            expect = sum(r + 1 for r in range(nw)) / nw
+            np.testing.assert_allclose(out.numpy(), np.full((50,), expect))
+
+            # fp16 wire compression
+            base = rng.standard_normal(512).astype(np.float32) * 0.1
+            x = tf.constant(base * (rank + 1))
+            out = bps.push_pull(x, average=False, name="half",
+                                compression=bps.Compression.fp16)
+            scale = sum(r + 1 for r in range(nw))
+            assert out.dtype == tf.float32
+            np.testing.assert_allclose(out.numpy(), base * scale,
+                                       rtol=2e-3, atol=2e-3)
+
+            # inside a tf.function graph (the reference's custom-op path)
+            @tf.function
+            def graph_pp(t):
+                return bps.push_pull(t, average=False, name="graphed")
+
+            z = tf.fill((32,), float(rank + 1))
+            out = graph_pp(z)
+            assert out.shape == (32,)
+            np.testing.assert_allclose(
+                out.numpy(), np.full((32,), float(sum(r + 1
+                                                      for r in range(nw)))))
+
+        elif mode == "broadcast":
+            tf.random.set_seed(100 + rank)  # different init per rank
+            v = tf.Variable(tf.random.normal((17, 3)))
+            w = tf.Variable(tf.random.normal((5,)))
+            bps.broadcast_variables([v, w], root_rank=0)
+            tf.random.set_seed(100)
+            ref_v = tf.random.normal((17, 3))
+            ref_w = tf.random.normal((5,))
+            np.testing.assert_allclose(v.numpy(), ref_v.numpy())
+            np.testing.assert_allclose(w.numpy(), ref_w.numpy())
+
+        elif mode == "tape_train":
+            # DistributedGradientTape custom loop reproduces single-process
+            # numerics: every rank sees the same average gradient.
+            tf.random.set_seed(7)
+            model = tf.keras.Sequential([
+                tf.keras.layers.Dense(16, activation="tanh",
+                                      input_shape=(6,)),
+                tf.keras.layers.Dense(3)])
+            bps.broadcast_variables(model.variables, root_rank=0)
+            opt = tf.keras.optimizers.SGD(learning_rate=0.05)
+            xs = rng.standard_normal((nw, 4, 8, 6)).astype(np.float32)
+            ys = rng.standard_normal((nw, 4, 8, 3)).astype(np.float32)
+            for step in range(4):
+                with bps.DistributedGradientTape(tf.GradientTape()) as tape:
+                    pred = model(xs[rank, step], training=True)
+                    loss = tf.reduce_mean((pred - ys[rank, step]) ** 2)
+                grads = tape.gradient(loss, model.trainable_variables)
+                opt.apply_gradients(zip(grads, model.trainable_variables))
+            # all ranks end bitwise-identical
+            digest = np.concatenate(
+                [v.numpy().reshape(-1) for v in model.trainable_variables])
+            got = bps.push_pull(tf.constant(digest), average=True,
+                                name="digest")
+            np.testing.assert_allclose(got.numpy(), digest, rtol=0, atol=0)
+
+        elif mode == "dist_opt":
+            # DistributedOptimizer path: apply_gradients communicates.
+            tf.random.set_seed(7)
+            model = tf.keras.Sequential([
+                tf.keras.layers.Dense(8, activation="relu",
+                                      input_shape=(6,)),
+                tf.keras.layers.Dense(2)])
+            bps.broadcast_variables(model.variables, root_rank=0)
+            opt = bps.DistributedOptimizer(
+                tf.keras.optimizers.SGD(learning_rate=0.05))
+            xs = rng.standard_normal((nw, 3, 8, 6)).astype(np.float32)
+            ys = rng.standard_normal((nw, 3, 8, 2)).astype(np.float32)
+            for step in range(3):
+                with tf.GradientTape() as tape:
+                    pred = model(xs[rank, step], training=True)
+                    loss = tf.reduce_mean((pred - ys[rank, step]) ** 2)
+                grads = tape.gradient(loss, model.trainable_variables)
+                opt.apply_gradients(zip(grads, model.trainable_variables))
+            digest = np.concatenate(
+                [v.numpy().reshape(-1) for v in model.trainable_variables])
+            got = bps.push_pull(tf.constant(digest), average=True,
+                                name="digest")
+            np.testing.assert_allclose(got.numpy(), digest, rtol=0, atol=0)
+
+        elif mode == "keras_fit":
+            # Full keras plugin: model.fit with DistributedOptimizer and
+            # the callback set.
+            import byteps_tpu.keras as kbps
+            from byteps_tpu.keras.callbacks import (
+                BroadcastGlobalVariablesCallback, LearningRateWarmupCallback,
+                MetricAverageCallback)
+
+            tf.random.set_seed(20 + rank)  # per-rank init, callback syncs
+            model = tf.keras.Sequential([
+                tf.keras.layers.Dense(8, activation="tanh",
+                                      input_shape=(4,)),
+                tf.keras.layers.Dense(1)])
+            model.compile(
+                optimizer=kbps.DistributedOptimizer(
+                    tf.keras.optimizers.SGD(learning_rate=0.01)),
+                loss="mse", run_eagerly=True)
+            x = rng.standard_normal((32, 4)).astype(np.float32)
+            y = rng.standard_normal((32, 1)).astype(np.float32)
+            hist = model.fit(
+                x, y, batch_size=8, epochs=2, verbose=0,
+                callbacks=[BroadcastGlobalVariablesCallback(0),
+                           MetricAverageCallback(),
+                           LearningRateWarmupCallback(
+                               initial_lr=0.01, warmup_epochs=2,
+                               steps_per_epoch=4)])
+            assert len(hist.history["loss"]) == 2
+            digest = np.concatenate(
+                [v.numpy().reshape(-1) for v in model.trainable_variables])
+            got = bps.push_pull(tf.constant(digest), average=True,
+                                name="digest")
+            np.testing.assert_allclose(got.numpy(), digest, rtol=0, atol=0)
+
+        else:
+            raise SystemExit(f"unknown BPS_TEST_MODE {mode!r}")
+
+        print(f"worker {rank} mode={mode}: OK")
+        return 0
+    finally:
+        bps.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

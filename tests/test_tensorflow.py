@@ -1,0 +1,104 @@
+"""TensorFlow/Keras-plugin tests over the real localhost PS topology.
+
+Reference analogue: tests/test_tensorflow.py run under run_byteps_test.sh
+(SURVEY.md §4) — real scheduler + server + N single-device workers on
+127.0.0.1, numerics asserted inside the workers (tests/_tf_worker.py).
+"""
+
+import os
+
+import pytest
+
+from tests.ps_utils import run_topology
+
+WORKER = os.path.join(os.path.dirname(__file__), "_tf_worker.py")
+
+ps = pytest.mark.ps  # topology tests are slow; fast suite: -m "not ps"
+
+# TF imports take several seconds per worker process.
+TF_TIMEOUT = 180.0
+
+
+@ps
+def test_tf_push_pull():
+    run_topology(2, 1, WORKER, mode="push_pull", timeout=TF_TIMEOUT)
+
+
+@ps
+def test_tf_broadcast():
+    run_topology(2, 1, WORKER, mode="broadcast", timeout=TF_TIMEOUT)
+
+
+@ps
+def test_tf_distributed_gradient_tape():
+    run_topology(2, 1, WORKER, mode="tape_train", timeout=TF_TIMEOUT)
+
+
+@ps
+def test_tf_distributed_optimizer():
+    run_topology(2, 1, WORKER, mode="dist_opt", timeout=TF_TIMEOUT)
+
+
+@ps
+def test_keras_fit_with_callbacks():
+    run_topology(2, 1, WORKER, mode="keras_fit", timeout=TF_TIMEOUT)
+
+
+def test_tf_single_process_fallback():
+    """No scheduler configured → every collective degrades to a local
+    no-op (reference: non-distributed mode)."""
+    import subprocess
+    import sys
+
+    code = """
+import os
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+import numpy as np
+import tensorflow as tf
+import byteps_tpu.tensorflow as bps
+from byteps_tpu.config import Config
+bps.init(Config(num_worker=1, num_server=0))
+assert bps.size() == 1 and bps.rank() == 0
+x = tf.ones((8,))
+np.testing.assert_allclose(bps.push_pull(x, average=True).numpy(),
+                           np.ones(8))
+np.testing.assert_allclose(bps.broadcast(x, root_rank=0).numpy(),
+                           np.ones(8))
+v = tf.Variable(tf.ones((3,)))
+bps.broadcast_variables([v], root_rank=0)
+model = tf.keras.Sequential(
+    [tf.keras.layers.Dense(2, input_shape=(4,))])
+opt = bps.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
+with bps.DistributedGradientTape(tf.GradientTape()) as tape:
+    loss = tf.reduce_sum(model(tf.ones((2, 4))) ** 2)
+grads = tape.gradient(loss, model.trainable_variables)
+opt.apply_gradients(zip(grads, model.trainable_variables))
+bps.shutdown()
+print("OK")
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
+    for var in ("DMLC_NUM_SERVER", "DMLC_NUM_WORKER", "DMLC_ROLE",
+                "BYTEPS_FORCE_DISTRIBUTED"):
+        env.pop(var, None)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OK" in out.stdout
+
+
+def test_mxnet_plugin_gated():
+    """byteps_tpu.mxnet raises a clear ImportError when mxnet is absent
+    (and imports cleanly when it is present)."""
+    try:
+        import mxnet  # noqa: F401
+        have_mx = True
+    except ImportError:
+        have_mx = False
+    if have_mx:
+        import byteps_tpu.mxnet as mbps
+        assert hasattr(mbps, "DistributedTrainer")
+    else:
+        with pytest.raises(ImportError, match="byteps_tpu.jax"):
+            import byteps_tpu.mxnet  # noqa: F401
