@@ -35,16 +35,26 @@ def _make_timer(steps: int, warmup: int):
     ``items`` is the item count the supplied batch actually carries, so no
     post-hoc rescaling exists to forget."""
     import jax
+    import numpy as np
+
+    def _sync(state) -> None:
+        # block_until_ready alone is not sufficient on tunneled/remote
+        # PJRT platforms (it can return at dispatch, not completion);
+        # fetching a scalar from the last output forces the whole
+        # dependent chain to actually finish on the chip.
+        jax.block_until_ready(state)
+        leaves = jax.tree_util.tree_leaves(state)
+        np.asarray(jax.numpy.ravel(leaves[-1])[0])
 
     def timed(step, state, batch_parts, items: int):
         state = step(*state, batch_parts)  # warm compile
         for _ in range(warmup - 1):
             state = step(*state[:-1], batch_parts)
-        jax.block_until_ready(state)
+        _sync(state)
         t0 = time.perf_counter()
         for _ in range(steps):
             state = step(*state[:-1], batch_parts)
-        jax.block_until_ready(state)
+        _sync(state)
         return items * steps / (time.perf_counter() - t0)
 
     return timed
@@ -54,8 +64,10 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=0, help="global batch "
                    "(default: 64 per chip; bert: 8 per chip)")
-    p.add_argument("--steps", type=int, default=20)
-    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--repeats", type=int, default=3,
+                   help="alternating best-of repeats per path (drift guard)")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--model", choices=["resnet50", "bert"],
                    default="resnet50",
@@ -121,19 +133,40 @@ def main() -> None:
     # batch on one device, so vs_baseline is per-chip throughput retention
     # (framework overhead + comm), not an inflated multi-chip speedup.
     per_chip = max(1, batch // n_dev)
-    state2 = (variables["params"], variables["batch_stats"],
-              tx.init(variables["params"]))
-    plain_ips = timed(plain_step, state2, (x[:per_chip], y[:per_chip]),
-                      per_chip)
+    # Materialise the baseline slice before shard_batch touches x/y (its
+    # device_put can invalidate the originals on some platforms).
+    plain_batch = (jnp.array(x[:per_chip]), jnp.array(y[:per_chip]))
+
+    def run_plain():
+        state2 = (jax.tree_util.tree_map(jnp.array, variables["params"]),
+                  jax.tree_util.tree_map(jnp.array,
+                                         variables["batch_stats"]),
+                  tx.init(variables["params"]))
+        return timed(plain_step, state2, plain_batch, per_chip)
 
     # --- byteps_tpu path ---
     bps.init()
     mesh = bps.mesh()
     step = make_flax_train_step(model.apply, tx, mesh)
-    state = (replicate(variables["params"], mesh),
-             replicate(variables["batch_stats"], mesh),
-             replicate(tx.init(variables["params"]), mesh))
-    bench_ips = timed(step, state, shard_batch((x, y), mesh), batch)
+    batch_parts = shard_batch((x, y), mesh)
+
+    # Host-side snapshot: replicate()'s device_put may alias the source
+    # buffers, and the framework step donates its inputs — each repeat
+    # must rebuild device state from untouched host copies.
+    host_vars = jax.tree_util.tree_map(np.asarray, variables)
+
+    def run_bps():
+        state = (replicate(host_vars["params"], mesh),
+                 replicate(host_vars["batch_stats"], mesh),
+                 replicate(tx.init(host_vars["params"]), mesh))
+        return timed(step, state, batch_parts, batch)
+
+    # The chip may be shared / tunneled, so single measurements drift;
+    # alternate the two paths and keep each one's best.
+    plain_ips = bench_ips = 0.0
+    for _ in range(args.repeats):
+        plain_ips = max(plain_ips, run_plain())
+        bench_ips = max(bench_ips, run_bps())
 
     print(json.dumps({
         "metric": "resnet50_train_imgs_per_sec_per_chip"
@@ -194,17 +227,27 @@ def bench_bert(args) -> None:
         return optax.apply_updates(p, u), opt_state, loss
 
     per_chip = max(1, batch // n_dev)
-    plain_ips = timed(plain_step, (params, tx.init(params)),
-                      (toks[:per_chip], mask[:per_chip]), per_chip)
+    plain_batch = (jnp.array(toks[:per_chip]), jnp.array(mask[:per_chip]))
 
     bps.init()
     mesh = bps.mesh()
     # The framework step: hierarchical push_pull + donated buffers; in PS
     # mode this routes the DCN leg through the C++ KV client.
     bps_step = make_train_step(loss_fn, tx, mesh)
-    state = (replicate(params, mesh), replicate(tx.init(params), mesh))
-    bench_ips = timed(bps_step, state, shard_batch((toks, mask), mesh),
-                      batch)
+    batch_parts = shard_batch((toks, mask), mesh)
+
+    # Alternate paths, keep each one's best (shared/tunneled chips drift).
+    plain_ips = bench_ips = 0.0
+    host_params = jax.tree_util.tree_map(np.asarray, params)
+    for _ in range(args.repeats):
+        plain_ips = max(plain_ips, timed(
+            plain_step,
+            (jax.tree_util.tree_map(jnp.array, host_params),
+             tx.init(params)), plain_batch, per_chip))
+        bench_ips = max(bench_ips, timed(
+            bps_step, (replicate(host_params, mesh),
+                       replicate(tx.init(params), mesh)),
+            batch_parts, batch))
 
     print(json.dumps({
         "metric": "bert_large_mlm_seqs_per_sec_per_chip"
