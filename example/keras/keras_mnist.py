@@ -1,0 +1,84 @@
+"""Distributed Keras training with byteps_tpu (model.fit + callbacks).
+
+Reference analogue: example/keras/keras_mnist_advanced.py. Uses a
+synthetic MNIST-shaped task (this environment has no dataset egress);
+swap in tf.keras.datasets.mnist for the real thing.
+
+    python -m byteps_tpu.launcher --local 2 --num-servers 1 -- \
+        python example/keras/keras_mnist.py --epochs 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+
+def synthetic_mnist(n: int, seed: int):
+    """Separable 10-class 28x28 task: class k lights up block k."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 10, n)
+    x = rng.standard_normal((n, 28, 28, 1)).astype(np.float32) * 0.3
+    for i, k in enumerate(y):
+        x[i, 2 * k:2 * k + 3, 2 * k:2 * k + 3, 0] += 2.0
+    return x, y.astype(np.int64)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--samples", type=int, default=2048)
+    args = p.parse_args()
+
+    import tensorflow as tf
+
+    import byteps_tpu.keras as bps
+
+    bps.init()
+    # per-worker shard of the data (the reference shards by rank too)
+    x, y = synthetic_mnist(args.samples, seed=42)
+    shard = slice(bps.rank(), None, bps.size())
+    x, y = x[shard], y[shard]
+
+    tf.random.set_seed(1 + bps.rank())  # callback broadcasts rank 0's init
+    model = tf.keras.Sequential([
+        tf.keras.layers.Conv2D(8, 3, activation="relu",
+                               input_shape=(28, 28, 1)),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(64, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    # linear-scaling rule: lr grows with the worker count, with warmup
+    model.compile(
+        optimizer=bps.DistributedOptimizer(
+            tf.keras.optimizers.SGD(learning_rate=args.lr)),
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(
+            from_logits=True),
+        metrics=["accuracy"], run_eagerly=True)
+
+    steps_per_epoch = max(1, len(x) // args.batch_size)
+    hist = model.fit(
+        x, y, batch_size=args.batch_size, epochs=args.epochs,
+        verbose=2 if bps.rank() == 0 else 0,
+        callbacks=[
+            bps.callbacks.BroadcastGlobalVariablesCallback(0),
+            bps.callbacks.MetricAverageCallback(),
+            bps.callbacks.LearningRateWarmupCallback(
+                initial_lr=args.lr, multiplier=bps.size(),
+                warmup_epochs=min(2, args.epochs),
+                steps_per_epoch=steps_per_epoch),
+        ])
+    if bps.rank() == 0:
+        print(f"final accuracy: {hist.history['accuracy'][-1]:.4f}")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

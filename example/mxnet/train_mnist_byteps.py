@@ -1,0 +1,60 @@
+"""Distributed MXNet/gluon training with byteps_tpu.
+
+Reference analogue: example/mxnet/train_mnist_byteps.py. Requires the
+``mxnet`` package (not installed in this image — byteps_tpu.mxnet raises
+a clear ImportError pointing at the jax/torch/tensorflow plugins).
+
+    python -m byteps_tpu.launcher --local 2 --num-servers 1 -- \
+        python example/mxnet/train_mnist_byteps.py --epochs 3
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.05)
+    args = p.parse_args()
+
+    import mxnet as mx
+    from mxnet import autograd, gluon
+
+    import byteps_tpu.mxnet as bps
+
+    bps.init()
+    mx.random.seed(1 + bps.rank())
+
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Conv2D(8, 3, activation="relu"),
+            gluon.nn.MaxPool2D(),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize()
+    _ = net(mx.nd.zeros((1, 1, 28, 28)))  # materialise params
+    bps.broadcast_parameters(net.collect_params(), root_rank=0)
+
+    trainer = bps.DistributedTrainer(
+        net.collect_params(), "sgd",
+        {"learning_rate": args.lr * bps.size()})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rng = mx.nd.random.uniform  # synthetic task, shaped like MNIST
+    for epoch in range(args.epochs):
+        x = mx.nd.random.normal(shape=(args.batch_size, 1, 28, 28))
+        y = mx.nd.floor(rng(0, 10, shape=(args.batch_size,)))
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(args.batch_size)
+        if bps.rank() == 0:
+            print(f"epoch {epoch}: loss {loss.mean().asscalar():.4f}")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Synthetic-data benchmark for the byteps_tpu.tensorflow plugin.
+
+Reference analogue: example/tensorflow/synthetic_benchmark.py (Horovod
+layout). Launch under a PS topology:
+
+    python -m byteps_tpu.launcher --local 2 --num-servers 1 -- \
+        python example/tensorflow/synthetic_benchmark.py --num-iters 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--hidden", type=int, default=512)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--num-warmup", type=int, default=2)
+    p.add_argument("--fp16-wire", action="store_true",
+                   help="fp16 wire compression for the push/pull stage")
+    args = p.parse_args()
+
+    import numpy as np
+    import tensorflow as tf
+
+    import byteps_tpu.tensorflow as bps
+
+    bps.init()
+    tf.random.set_seed(0)
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(args.hidden, activation="relu",
+                               input_shape=(args.hidden,))
+         for _ in range(args.layers)]
+        + [tf.keras.layers.Dense(10)])
+    _ = model(tf.zeros((1, args.hidden)))  # build
+    bps.broadcast_variables(model.variables, root_rank=0)
+
+    compression = (bps.Compression.fp16 if args.fp16_wire
+                   else bps.Compression.none)
+    opt = tf.keras.optimizers.SGD(learning_rate=0.01)
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+
+    rng = np.random.default_rng(bps.rank())
+    x = tf.constant(rng.standard_normal(
+        (args.batch_size, args.hidden)).astype(np.float32))
+    y = tf.constant(rng.integers(0, 10, args.batch_size))
+
+    def one_iter():
+        with bps.DistributedGradientTape(tf.GradientTape(),
+                                         compression=compression) as tape:
+            loss = loss_fn(y, model(x, training=True))
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+
+    for _ in range(args.num_warmup):
+        one_iter()
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        one_iter()
+    dt = time.perf_counter() - t0
+    ips = args.batch_size * args.num_iters / dt
+    if bps.rank() == 0:
+        print(f"Iter throughput: {ips:.1f} images/sec per worker "
+              f"({ips * bps.size():.1f} total, {bps.size()} workers)")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

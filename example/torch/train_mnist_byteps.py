@@ -1,0 +1,82 @@
+"""Distributed PyTorch training with byteps_tpu (mnist-style).
+
+Reference analogue: example/pytorch/train_mnist_byteps.py. Synthetic
+MNIST-shaped task (no dataset egress here); swap in torchvision MNIST
+for the real thing.
+
+    python -m byteps_tpu.launcher --local 2 --num-servers 1 -- \
+        python example/torch/train_mnist_byteps.py --epochs 3
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def synthetic_mnist(n: int, seed: int):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 10, n)
+    x = rng.standard_normal((n, 1, 28, 28)).astype("float32") * 0.3
+    for i, k in enumerate(y):
+        x[i, 0, 2 * k:2 * k + 3, 2 * k:2 * k + 3] += 2.0
+    return x, y.astype("int64")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--samples", type=int, default=2048)
+    args = p.parse_args()
+
+    import torch
+    import torch.nn.functional as F
+
+    import byteps_tpu.torch as bps
+
+    bps.init()
+    torch.manual_seed(1 + bps.rank())  # broadcast syncs to rank 0's init
+
+    model = torch.nn.Sequential(
+        torch.nn.Conv2d(1, 8, 3), torch.nn.ReLU(),
+        torch.nn.MaxPool2d(2), torch.nn.Flatten(),
+        torch.nn.Linear(8 * 13 * 13, 64), torch.nn.ReLU(),
+        torch.nn.Linear(64, 10))
+    bps.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    opt = bps.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(),
+                        lr=args.lr * bps.size()),  # linear-scaling rule
+        named_parameters=model.named_parameters())
+    bps.broadcast_optimizer_state(opt, root_rank=0)
+
+    x, y = synthetic_mnist(args.samples, seed=42)
+    shard = slice(bps.rank(), None, bps.size())
+    xs = torch.from_numpy(x[shard])
+    ys = torch.from_numpy(y[shard])
+
+    for epoch in range(args.epochs):
+        perm = torch.randperm(len(xs),
+                              generator=torch.Generator().manual_seed(epoch))
+        correct = total = 0
+        for i in range(0, len(xs) - args.batch_size + 1, args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            bx, by = xs[idx], ys[idx]
+            opt.zero_grad()
+            out = model(bx)
+            loss = F.cross_entropy(out, by)
+            loss.backward()          # hooks overlap push_pull with backward
+            opt.step()
+            correct += (out.argmax(1) == by).sum().item()
+            total += len(by)
+        if bps.rank() == 0:
+            print(f"epoch {epoch}: train accuracy {correct / total:.4f}")
+    print(f"final accuracy: {correct / total:.4f}")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

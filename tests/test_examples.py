@@ -73,3 +73,57 @@ def test_torch_benchmark_under_launcher():
         env=env, capture_output=True, text=True, timeout=300)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "throughput" in out.stdout
+
+
+@pytest.mark.ps
+def test_tf_synthetic_benchmark_under_launcher():
+    from tests.ps_utils import free_port
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DMLC_PS_ROOT_PORT"] = str(free_port())
+    out = subprocess.run(
+        [sys.executable, "-m", "byteps_tpu.launcher", "--local", "2",
+         "--num-servers", "1", "--",
+         sys.executable,
+         os.path.join(EX, "tensorflow", "synthetic_benchmark.py"),
+         "--num-iters", "3", "--layers", "2", "--hidden", "128"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "throughput" in out.stdout
+
+
+@pytest.mark.ps
+def test_keras_mnist_under_launcher():
+    from tests.ps_utils import free_port
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DMLC_PS_ROOT_PORT"] = str(free_port())
+    out = subprocess.run(
+        [sys.executable, "-m", "byteps_tpu.launcher", "--local", "2",
+         "--num-servers", "1", "--",
+         sys.executable, os.path.join(EX, "keras", "keras_mnist.py"),
+         "--epochs", "2", "--samples", "512"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "final accuracy" in out.stdout
+
+
+@pytest.mark.ps
+def test_torch_mnist_under_launcher():
+    from tests.ps_utils import free_port
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DMLC_PS_ROOT_PORT"] = str(free_port())
+    out = subprocess.run(
+        [sys.executable, "-m", "byteps_tpu.launcher", "--local", "2",
+         "--num-servers", "1", "--",
+         sys.executable, os.path.join(EX, "torch", "train_mnist_byteps.py"),
+         "--epochs", "2", "--samples", "512"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "final accuracy" in out.stdout
+    acc = float(out.stdout.strip().split("final accuracy:")[-1])
+    assert acc > 0.5, out.stdout
