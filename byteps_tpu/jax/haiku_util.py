@@ -1,0 +1,119 @@
+"""dm-haiku integration: data-parallel train step for transformed functions.
+
+Same contract as the reference's per-framework plugins (SURVEY.md §2.5 —
+it shipped adapters for every framework its users trained with): a haiku
+``hk.transform`` / ``hk.transform_with_state`` pair gets the canonical
+jitted shard_map'd step — per-device forward/backward, hierarchical
+push_pull on gradients, pmean'd haiku state (sync-BN-style), optimizer
+update — matching ``make_flax_train_step`` for flax.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import byteps_tpu.jax as bps
+from byteps_tpu.jax._compat import shard_map as _shard_map
+from byteps_tpu.jax.compression import Compression, Compressor
+
+
+def make_haiku_train_step(
+    loss_apply: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    *,
+    average: bool = True,
+    compression: Compressor = Compression.none,
+    donate: bool = True,
+    with_state: bool = False,
+    rng: bool = False,
+):
+    """Build a DP step for a haiku-transformed loss.
+
+    - ``with_state=False``: ``loss_apply = hk.transform(f).apply`` where
+      ``f(batch) -> scalar loss``; step signature
+      ``step(params, opt_state, key, batch) -> (params, opt_state, loss)``
+      (``key=None`` when ``rng=False`` — haiku's without_apply_rng).
+    - ``with_state=True``: ``loss_apply = hk.transform_with_state(f).apply``
+      returning ``(loss, new_hk_state)``; step signature
+      ``step(params, hk_state, opt_state, key, batch) ->
+      (params, hk_state, opt_state, loss)``; state is pmean'd across
+      replicas each step like flax batch_stats.
+
+    Batch leaves are sharded over the (dcn, ici) axes; params/state/
+    opt_state replicated. Per-device RNG: the key is folded with the
+    device's linear mesh index so dropout differs across replicas.
+    """
+    mesh = mesh or bps.mesh()
+    cfg = bps._st().config
+    axes = tuple(a for a in (cfg.dcn_axis, cfg.ici_axis)
+                 if a in mesh.axis_names)
+
+    def _device_key(key):
+        if key is None:
+            return None
+        idx = 0
+        for ax in axes:
+            idx = idx * lax.axis_size(ax) + lax.axis_index(ax)
+        return jax.random.fold_in(key, idx)
+
+    def _sync(loss, grads):
+        grads = bps.push_pull(grads, average=average,
+                              compression=compression)
+        for ax in axes:
+            loss = lax.pmean(loss, ax)
+        return loss, grads
+
+    if with_state:
+        @partial(_shard_map, mesh=mesh,
+                 in_specs=(P(), P(), P(), P(), P(axes)),
+                 out_specs=(P(), P(), P(), P()),
+                 check_vma=False)
+        def _step(params, hk_state, opt_state, key, batch):
+            def compute_loss(p):
+                loss, new_state = loss_apply(p, hk_state, _device_key(key),
+                                             batch)
+                return loss, new_state
+
+            (loss, new_state), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(params)
+            loss, grads = _sync(loss, grads)
+            for ax in axes:
+                new_state = jax.tree_util.tree_map(
+                    lambda s, a=ax: lax.pmean(s, a), new_state)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, new_state, opt_state, loss
+
+        jit_kwargs = {"donate_argnums": (0, 1, 2)} if donate else {}
+        jitted = jax.jit(_step, **jit_kwargs)
+
+        def step(params, hk_state, opt_state, key, batch):
+            return jitted(params, hk_state, opt_state, key, batch)
+
+        return step
+
+    @partial(_shard_map, mesh=mesh,
+             in_specs=(P(), P(), P(), P(axes)),
+             out_specs=(P(), P(), P()),
+             check_vma=False)
+    def _step(params, opt_state, key, batch):
+        def compute_loss(p):
+            if rng:
+                return loss_apply(p, _device_key(key), batch)
+            return loss_apply(p, None, batch)
+
+        loss, grads = jax.value_and_grad(compute_loss)(params)
+        loss, grads = _sync(loss, grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    jit_kwargs = {"donate_argnums": (0, 1)} if donate else {}
+    return jax.jit(_step, **jit_kwargs)
