@@ -1,0 +1,159 @@
+"""Per-layer compute/communication overlap for PS-mode JAX training.
+
+SURVEY.md §7 "hard part #1": the reference's torch plugin registers
+per-parameter autograd hooks so each gradient starts its push the moment
+backward produces it (byteps/torch/__init__.py _make_hook) — communication
+overlaps the *rest of backward*. JAX has no hooks: gradients normally
+leave ``value_and_grad`` all at once, so PS-mode pushes can only start
+after the whole backward finishes.
+
+This module recovers hook-style streaming inside the jitted program:
+every parameter leaf is wrapped in a ``custom_vjp`` identity *tap* whose
+backward rule fires a ``jax.experimental.io_callback``. When XLA's
+backward pass materialises that parameter's gradient, the callback hands
+it straight to the C++ KV worker's priority-credit push queue — while the
+device continues with the remaining backward compute. After the step's
+dispatch completes, the host waits on the per-tensor handles (pulls) and
+applies the optimizer update.
+
+Priorities follow parameter declaration order (flattened tree order =
+front-of-model first for standard model pytrees), so early layers' pulls
+complete first — exactly the reference's scheduling rationale.
+
+Topology contract: one JAX process per accelerator (the reference's
+process-per-GPU layout). The local mesh must be a single device; use the
+regular ``make_train_step`` when one controller drives several chips.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.experimental import io_callback
+
+import byteps_tpu.jax as bps
+
+
+class _TapState:
+    """Declared tensors + in-flight handles for one step builder."""
+
+    def __init__(self, client, prefix: str, average: bool,
+                 compression_config: Optional[str]):
+        self.client = client
+        self.prefix = prefix
+        self.average = average
+        self.compression_config = compression_config
+        self.tids: Dict[int, int] = {}
+        self.lock = threading.Lock()
+        self.inflight: Dict[int, Tuple[int, np.ndarray]] = {}
+
+    def declare_all(self, leaves) -> None:
+        for i, leaf in enumerate(leaves):
+            self.tids[i] = self.client.declare(
+                f"{self.prefix}_{i}", int(np.size(leaf)),
+                np.dtype(leaf.dtype).name,
+                compression=self.compression_config)
+
+    def push(self, idx: int, g: np.ndarray) -> None:
+        # io_callback may hand a read-only view; the C core sums in place,
+        # so stage through a writable copy that also serves as the pull
+        # destination.
+        arr = np.array(g, copy=True).reshape(-1)
+        h = self.client.push_pull(self.tids[idx], arr,
+                                  average=self.average)
+        with self.lock:
+            self.inflight[idx] = (h, arr)
+
+    def collect(self, leaves):
+        out = []
+        for i, leaf in enumerate(leaves):
+            with self.lock:
+                h, arr = self.inflight.pop(i)
+            self.client.wait(h)
+            out.append(arr.reshape(leaf.shape).astype(leaf.dtype))
+        return out
+
+
+def _make_tap(state: _TapState, idx: int):
+    @jax.custom_vjp
+    def tap(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        # Fires mid-backward on the host: enqueue this tensor's push while
+        # the device keeps differentiating earlier layers.
+        io_callback(lambda arr: state.push(idx, arr), None, g,
+                    ordered=False)
+        return (g,)
+
+    tap.defvjp(fwd, bwd)
+    return tap
+
+
+def make_overlapped_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    *,
+    average: bool = True,
+    compression_config: Optional[str] = None,
+    prefix: str = "ograd",
+):
+    """Build ``step(params, opt_state, batch) -> (params, opt_state, loss)``
+    with hook-style push streaming (see module docstring).
+
+    ``loss_fn(params, batch) -> scalar``. ``compression_config`` is the
+    C-core codec string (e.g. ``"type=onebit;ef=vanilla"``) applied per
+    tensor on the DCN leg. The returned loss is this worker's local loss.
+    """
+    st = bps._st()
+    client = st.ps_client
+    if client is None:
+        raise RuntimeError(
+            "make_overlapped_train_step needs PS mode (init with "
+            "DMLC_NUM_SERVER>0 / BYTEPS_PS_MODE=ps)")
+    if st.mesh is not None and st.mesh.size != 1:
+        raise ValueError(
+            "overlapped steps drive one accelerator per process "
+            f"(local mesh has {st.mesh.size} devices); use "
+            "make_train_step for multi-chip controllers")
+
+    state = _TapState(client, prefix, average, compression_config)
+    taps: Dict[int, Callable] = {}
+
+    def tapped_loss(params, batch):
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        tapped = [taps[i](leaf) for i, leaf in enumerate(leaves)]
+        return loss_fn(jax.tree_util.tree_unflatten(treedef, tapped), batch)
+
+    grad_jit = jax.jit(lambda p, b: jax.value_and_grad(tapped_loss)(p, b)[0])
+
+    def apply_fn(params, opt_state, grads):
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    apply_jit = jax.jit(apply_fn)
+
+    def step(params, opt_state, batch):
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        if not taps:
+            state.declare_all(leaves)
+            for i in range(len(leaves)):
+                taps[i] = _make_tap(state, i)
+        loss = grad_jit(params, batch)
+        # Block for the device (all taps have fired by completion); pushes
+        # already overlapped the backward pass.
+        loss.block_until_ready()
+        grads = jax.tree_util.tree_unflatten(treedef,
+                                             state.collect(leaves))
+        params, opt_state = apply_jit(params, opt_state, grads)
+        return params, opt_state, loss
+
+    return step

@@ -18,6 +18,8 @@ def main() -> int:
     mode = os.environ.get("BPS_TEST_MODE", "basic")
     if mode == "jax_train":
         return jax_train_main()
+    if mode == "jax_overlap":
+        return jax_overlap_main()
     w = Worker.start()
     rank = w.worker_rank()
     nw = w.num_workers()
@@ -266,6 +268,88 @@ def jax_train_main() -> int:
             rtol=2e-4, atol=2e-5)
     bps_jax.shutdown()
     print(f"worker {rank}: jax_train OK")
+    return 0
+
+
+def jax_overlap_main() -> int:
+    """Per-layer overlapped PS training (custom_vjp taps + io_callback)
+    must reproduce single-process numerics exactly — the hook-streaming
+    analogue of jax_train_main."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    import byteps_tpu.jax as bps_jax
+    from byteps_tpu.config import get_config
+    from byteps_tpu.jax.overlap import make_overlapped_train_step
+
+    cfg = get_config(reload=True)
+    assert cfg.use_ps, "expected PS mode in jax_overlap"
+    bps_jax.init()
+    try:
+        return _jax_overlap_body()
+    finally:
+        # always tear down the C++ worker threads, or a failing assert
+        # leaves this process (and the whole fleet) hanging
+        bps_jax.shutdown()
+
+
+def _jax_overlap_body() -> int:
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import byteps_tpu.jax as bps_jax
+    from byteps_tpu.jax.overlap import make_overlapped_train_step
+
+    st = bps_jax._st()
+    rank = st.ps_client.worker_rank()
+    nw = st.ps_client.num_workers()
+
+    def loss_fn(params, batch):
+        x, y = batch
+        h = jnp.tanh(x @ params["w1"] + params["b1"])
+        pred = h @ params["w2"]
+        return jnp.mean((pred - y) ** 2)
+
+    prng = np.random.default_rng(5)
+    params0 = {
+        "w1": jnp.asarray(prng.standard_normal((6, 8)), jnp.float32) * 0.4,
+        "b1": jnp.zeros((8,), jnp.float32),
+        "w2": jnp.asarray(prng.standard_normal((8, 3)), jnp.float32) * 0.4,
+    }
+    tx = optax.sgd(0.1)
+    step = make_overlapped_train_step(loss_fn, tx)
+    params = jax.tree_util.tree_map(jnp.array, params0)
+    opt_state = tx.init(params)
+    per = 8
+    for _ in range(6):
+        gx = prng.standard_normal((nw * per, 6)).astype(np.float32)
+        gy = gx[:, :3] * 2.0
+        lo, hi = rank * per, (rank + 1) * per
+        params, opt_state, loss = step(params, opt_state,
+                                       (gx[lo:hi], gy[lo:hi]))
+
+    ref_prng = np.random.default_rng(5)
+    ref_prng.standard_normal((6, 8))
+    ref_prng.standard_normal((8, 3))
+
+    @jax.jit
+    def ref_step(p, s, batch):
+        _, g = jax.value_and_grad(loss_fn)(p, batch)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    ref_params = jax.tree_util.tree_map(jnp.array, params0)
+    ref_state = tx.init(ref_params)
+    for _ in range(6):
+        gx = ref_prng.standard_normal((nw * per, 6)).astype(np.float32)
+        gy = gx[:, :3] * 2.0
+        ref_params, ref_state = ref_step(ref_params, ref_state, (gx, gy))
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(params[k]), np.asarray(ref_params[k]),
+            rtol=2e-4, atol=2e-5)
+    print(f"worker {rank}: jax_overlap OK")
     return 0
 
 

@@ -131,3 +131,13 @@ def test_jax_ps_single_worker_force_distributed():
     run_topology(1, 1, WORKER, mode="jax_train",
                  extra={"BYTEPS_PS_MODE": "ps",
                         "BYTEPS_FORCE_DISTRIBUTED": "1"}, timeout=180)
+
+
+def test_jax_overlapped_training_matches_single_process():
+    """Hook-style per-layer push streaming (custom_vjp taps + io_callback,
+    SURVEY.md §7 hard part #1) reproduces single-process numerics."""
+    # Workers are one-accelerator processes (the reference's layout):
+    # drop the pytest env's 8-device XLA flag for the children.
+    run_topology(2, 1, WORKER, mode="jax_overlap",
+                 extra={"BYTEPS_PS_MODE": "ps", "XLA_FLAGS": ""},
+                 timeout=180)
