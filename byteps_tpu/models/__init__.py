@@ -10,6 +10,12 @@ MLP used by the test suite.
 from byteps_tpu.models.mlp import MLP  # noqa: F401
 from byteps_tpu.models.resnet import ResNet, ResNet18, ResNet50  # noqa: F401
 from byteps_tpu.models.vgg import VGG, VGG16, VGG19  # noqa: F401
+from byteps_tpu.models.llama import (  # noqa: F401
+    Llama1B,
+    Llama7B,
+    LlamaModel,
+    LlamaTiny,
+)
 from byteps_tpu.models.transformer import (  # noqa: F401
     BertBase,
     BertLarge,

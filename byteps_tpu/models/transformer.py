@@ -26,8 +26,17 @@ from byteps_tpu.parallel.ulysses import ulysses_attention
 
 
 def _attention_fn(impl: str, sp_axis: Optional[str]) -> Callable:
-    if impl not in ("full", "ring", "ulysses"):
-        raise ValueError(f"attn_impl must be full|ring|ulysses, got {impl!r}")
+    if impl not in ("full", "flash", "ring", "ulysses"):
+        raise ValueError(
+            f"attn_impl must be full|flash|ring|ulysses, got {impl!r}")
+    if impl == "flash":
+        from byteps_tpu.ops.flash_attention import flash_attention
+        if sp_axis is None:
+            return flash_attention
+        # sequence-parallel + Pallas: Ulysses reshards to full sequences
+        # per device, the flash kernel runs the inner attention
+        return partial(ulysses_attention, axis=sp_axis,
+                       attn_fn=flash_attention)
     if impl == "full" or sp_axis is None:
         return full_attention
     if impl == "ring":
