@@ -28,6 +28,23 @@ struct Global {
   std::unique_ptr<BytePSWorker> worker;
   Role role = ROLE_WORKER;
   bool inited = false;
+
+  // Scripts that skip bps_finalize (no explicit shutdown) reach this
+  // destructor with everything still live. Members are destroyed in
+  // reverse declaration order, which would free the KVWorker BEFORE
+  // ~Postoffice runs the goodbye protocol — whose SHUTDOWN handling
+  // fires shutdown_cb_ -> kv->FailAllPending() on a van recv thread,
+  // a use-after-free that wedges that thread on a garbage mutex and
+  // deadlocks the van join (observed as workers hanging at exit).
+  // Finalize in dependency order here instead; ~Postoffice's own
+  // Finalize call is then an idempotent no-op.
+  ~Global() {
+    if (!inited) return;
+    if (worker) worker->Stop();
+    if (po) po->Finalize();
+    if (server) server->Stop();
+    inited = false;
+  }
 };
 
 Global* g() {

@@ -232,17 +232,25 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
         // A cleanly-departing node is not a failure: stop tracking it.
         last_heartbeat_ms_.erase(msg.head.sender);
         departed_.insert(msg.head.sender);
+        BPS_LOG(DEBUG) << "scheduler: goodbye from node " << msg.head.sender
+                       << " (" << barrier_counts_[-1] + 1 << "/"
+                       << num_workers_ << ")";
         if (++barrier_counts_[-1] == num_workers_) {
           MsgHeader h{};
           h.cmd = CMD_SHUTDOWN;
           h.sender = kSchedulerId;
           for (const auto& n : nodes_) {
-            if (n.id != kSchedulerId) van_->Send(node_fd_[n.id], h);
+            if (n.id != kSchedulerId) {
+              bool ok = van_->Send(node_fd_[n.id], h);
+              BPS_LOG(DEBUG) << "scheduler: SHUTDOWN -> node " << n.id
+                             << (ok ? " ok" : " FAILED");
+            }
           }
           shutting_down_.store(true);
           cv_.notify_all();
         }
       } else {
+        BPS_LOG(DEBUG) << "node " << my_id_ << ": received fleet SHUTDOWN";
         shutting_down_.store(true);
         {
           std::lock_guard<std::mutex> lk(mu_);
@@ -325,7 +333,9 @@ void Postoffice::Finalize() {
     MsgHeader h{};
     h.cmd = CMD_SHUTDOWN;
     h.sender = my_id_;
-    van_->Send(FdOf(kSchedulerId), h);
+    bool ok = van_->Send(FdOf(kSchedulerId), h);
+    BPS_LOG(DEBUG) << "worker " << my_id_ << ": goodbye sent ("
+                   << (ok ? "ok" : "FAILED") << "), awaiting fleet SHUTDOWN";
     std::unique_lock<std::mutex> lk(mu_);
     cv_.wait_for(lk, std::chrono::seconds(300),
                  [this] { return shutting_down_.load(); });
@@ -348,6 +358,7 @@ void Postoffice::Finalize() {
   }
   if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
   if (monitor_thread_.joinable()) monitor_thread_.join();
+  BPS_LOG(DEBUG) << "node " << my_id_ << ": finalize complete";
 }
 
 }  // namespace bps

@@ -65,6 +65,7 @@ def main() -> None:
         print(f"workers: {bps.size()}, params: {n_params / 1e6:.1f}M, "
               f"wire: {'fp16' if args.fp16_wire else 'fp32'}")
         print(f"throughput: {ips:.1f} samples/sec/worker")
+    bps.shutdown()
 
 
 if __name__ == "__main__":

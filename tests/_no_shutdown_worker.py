@@ -1,0 +1,19 @@
+"""Worker that deliberately skips bps.shutdown().
+
+Regression: scripts that exit without explicit shutdown tear down through
+the C++ Global destructor; member destruction order must keep the
+Postoffice goodbye protocol away from the freed KVWorker (a reversed
+order froze the van recv thread on a garbage mutex and hung the fleet).
+"""
+
+import torch
+
+import byteps_tpu.torch as bps
+
+bps.init()
+x = torch.ones(1000) * (bps.rank() + 1)
+out = bps.push_pull(x, average=False, name="t")
+expected = float(sum(r + 1 for r in range(bps.size())))
+assert torch.allclose(out, torch.full((1000,), expected))
+print(f"rank {bps.rank()}: ok")
+# NO bps.shutdown() — exit-time teardown is the point of this test.

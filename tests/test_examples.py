@@ -121,7 +121,7 @@ def test_torch_mnist_under_launcher():
         [sys.executable, "-m", "byteps_tpu.launcher", "--local", "2",
          "--num-servers", "1", "--",
          sys.executable, os.path.join(EX, "torch", "train_mnist_byteps.py"),
-         "--epochs", "2", "--samples", "512"],
+         "--epochs", "4", "--samples", "512"],
         env=env, capture_output=True, text=True, timeout=300)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "final accuracy" in out.stdout

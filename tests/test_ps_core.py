@@ -141,3 +141,12 @@ def test_jax_overlapped_training_matches_single_process():
     run_topology(2, 1, WORKER, mode="jax_overlap",
                  extra={"BYTEPS_PS_MODE": "ps", "XLA_FLAGS": ""},
                  timeout=180)
+
+
+def test_worker_exit_without_shutdown():
+    """A worker that never calls shutdown() must still tear down cleanly
+    at process exit (C++ Global destructor ordering regression)."""
+    import os as _os
+    worker = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                           "_no_shutdown_worker.py")
+    run_topology(2, 1, worker, timeout=120)
