@@ -79,11 +79,35 @@ class LlamaAttention(nn.Module):
         q = _rope(q, positions, self.rope_theta)
         k = _rope(k, positions, self.rope_theta)
         groups = self.num_heads // self.num_kv_heads
-        if groups > 1:  # GQA: share each KV head across its query group
-            k = jnp.repeat(k, groups, axis=2)
-            v = jnp.repeat(v, groups, axis=2)
-        attn = _attention_fn(self.attn_impl, self.sp_axis)
-        out = attn(q, k, v, causal=True)
+        out = None
+        if (groups > 1 and self.sp_axis is not None
+                and self.attn_impl in ("ulysses", "flash")):
+            # GQA + Ulysses: reshard the UNrepeated K/V heads (1/groups of
+            # the all-to-all bytes), expand per query group only after the
+            # exchange, inside the inner kernel.
+            from byteps_tpu.parallel.ulysses import ulysses_attention
+            if self.num_kv_heads % jax.lax.axis_size(self.sp_axis) == 0:
+                if self.attn_impl == "flash":
+                    from byteps_tpu.ops.flash_attention import \
+                        flash_attention as _inner
+                else:
+                    from byteps_tpu.parallel.ring_attention import \
+                        full_attention as _inner
+
+                def _grouped(q_, k_, v_, *, causal, scale=None):
+                    k_ = jnp.repeat(k_, groups, axis=2)
+                    v_ = jnp.repeat(v_, groups, axis=2)
+                    return _inner(q_, k_, v_, causal=causal, scale=scale)
+
+                out = ulysses_attention(q, k, v, axis=self.sp_axis,
+                                        causal=True, attn_fn=_grouped)
+        if out is None:
+            if groups > 1:
+                # local repeat: a gather XLA fuses into the attention
+                k = jnp.repeat(k, groups, axis=2)
+                v = jnp.repeat(v, groups, axis=2)
+            attn = _attention_fn(self.attn_impl, self.sp_axis)
+            out = attn(q, k, v, causal=True)
         return nn.DenseGeneral(d_model, axis=(-2, -1), use_bias=False,
                                dtype=self.dtype, name="o")(out)
 
@@ -165,12 +189,14 @@ class LlamaModel(nn.Module):
         return logits.astype(jnp.float32)
 
 
-# Named configurations. Tiny for tests; 1B/7B match the published shapes
-# (7B: 32 layers, d 4096, 32 heads, GQA off in v1 — kv=32).
+# Named configurations. Tiny is for tests. Llama1B follows TinyLlama-1.1B
+# (22 layers, d 2048, 32 heads, 4 KV heads, mlp 5632, vocab 32000);
+# Llama7B follows LLaMA-1/2-7B (32 layers, d 4096, 32 heads, no GQA,
+# mlp 11008, vocab 32000).
 LlamaTiny = partial(LlamaModel, vocab_size=1024, num_layers=2, d_model=64,
                     num_heads=4, num_kv_heads=2, mlp_dim=128)
-Llama1B = partial(LlamaModel, vocab_size=32000, num_layers=16,
-                  d_model=2048, num_heads=32, num_kv_heads=8, mlp_dim=5632)
+Llama1B = partial(LlamaModel, vocab_size=32000, num_layers=22,
+                  d_model=2048, num_heads=32, num_kv_heads=4, mlp_dim=5632)
 Llama7B = partial(LlamaModel, vocab_size=32000, num_layers=32,
                   d_model=4096, num_heads=32, num_kv_heads=32,
                   mlp_dim=11008)

@@ -37,7 +37,14 @@ def _attention_fn(impl: str, sp_axis: Optional[str]) -> Callable:
         # per device, the flash kernel runs the inner attention
         return partial(ulysses_attention, axis=sp_axis,
                        attn_fn=flash_attention)
-    if impl == "full" or sp_axis is None:
+    if impl == "full":
+        if sp_axis is not None:
+            raise ValueError(
+                "attn_impl='full' attends within each device's sequence "
+                "block only — silently wrong under sequence parallelism; "
+                "use 'ring', 'ulysses', or 'flash' with sp_axis")
+        return full_attention
+    if sp_axis is None:
         return full_attention
     if impl == "ring":
         return partial(ring_attention, axis=sp_axis)

@@ -121,3 +121,35 @@ def test_llama_sequence_parallel_matches_full(impl):
     out = fwd(params, toks)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_llama_gqa_ulysses_unrepeated_kv_matches_full():
+    """When kv heads divide the sp axis, K/V reshard unrepeated (1/groups
+    the all-to-all bytes) and expand after the exchange; numerics match
+    the single-device forward."""
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from byteps_tpu.jax._compat import shard_map as _shard_map
+    from byteps_tpu.models import LlamaModel
+
+    cfg = dict(vocab_size=512, num_layers=2, d_model=64, num_heads=8,
+               num_kv_heads=4, mlp_dim=128, dtype=jnp.float32)
+    rng = np.random.default_rng(9)
+    toks = jnp.asarray(rng.integers(0, 512, (2, 32)), jnp.int32)
+    ref_model = LlamaModel(**cfg)
+    params = ref_model.init(jax.random.PRNGKey(0), toks)
+    ref = ref_model.apply(params, toks)
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("sp",))
+    sp_model = LlamaModel(**cfg, attn_impl="ulysses", sp_axis="sp")
+
+    @partial(_shard_map, mesh=mesh, in_specs=(P(), P(None, "sp")),
+             out_specs=P(None, "sp"), check_vma=False)
+    def fwd(p, t):
+        return sp_model.apply(p, t)
+
+    out = fwd(params, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
