@@ -29,3 +29,11 @@ from byteps_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention_sharded,
 )
 from byteps_tpu.parallel.moe import moe_dispatch, moe_ffn  # noqa: F401
+from byteps_tpu.parallel.tensor_parallel import (  # noqa: F401
+    column_parallel,
+    row_parallel,
+    shard_columns,
+    shard_rows,
+    tp_attention,
+    tp_mlp,
+)
