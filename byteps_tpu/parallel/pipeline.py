@@ -1,0 +1,98 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+Beyond-reference scope (SURVEY.md §2.7: BytePS has no PP), same rationale
+as tensor_parallel.py — every mesh axis is first-class. TPU-first shape:
+the schedule is a single ``lax.fori_loop`` of identical SPMD ticks, with
+stage-to-stage transfer as a ring ``ppermute`` (one ICI hop), so XLA sees
+a static program: no per-stage host control flow, no dynamic shapes.
+Backward works through ``jax.grad`` (the transpose of ppermute is the
+reverse ppermute), giving full GPipe training semantics: all microbatch
+gradients accumulate before any optimizer step.
+
+Per-device code for use under ``jax.shard_map``: each device owns ONE
+stage's parameters and processes every microbatch in turn; with M
+microbatches and N stages the loop runs M + N - 1 ticks, the classic
+GPipe bubble fraction (N-1)/(M+N-1).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe(
+    stage_fn: Callable,
+    params_local,
+    microbatches: jax.Array,
+    *,
+    axis: str = "pp",
+) -> jax.Array:
+    """Run ``microbatches`` through the N-stage pipeline.
+
+    - ``stage_fn(params_local, x) -> y``: this device's stage; activations
+      ``x``/``y`` must share one shape across stages (the usual
+      transformer-block contract).
+    - ``params_local``: THIS device's stage parameters (e.g. produced by
+      slicing a stacked [N, ...] tree with ``lax.index_in_dim`` on
+      ``lax.axis_index(axis)``).
+    - ``microbatches``: [M, ...] replicated input; M >= 1.
+
+    Returns [M, ...] final-stage outputs, replicated to every device (one
+    all-gather-free ppermute ring closes the loop: the last stage feeds
+    device 0's carry, which is where outputs are read off).
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    m = microbatches.shape[0]
+    act_shape = microbatches.shape[1:]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    outputs0 = jnp.zeros((m,) + act_shape, microbatches.dtype)
+    carry0 = jnp.zeros(act_shape, microbatches.dtype)
+
+    def tick(t, state):
+        carry, outputs = state
+        # Stage 0 ingests microbatch t (while available); other stages
+        # consume what the ring delivered last tick.
+        feed_idx = jnp.clip(t, 0, m - 1)
+        first_in = lax.dynamic_index_in_dim(microbatches, feed_idx, 0,
+                                            keepdims=False)
+        x = jnp.where(idx == 0, first_in, carry)
+        y = stage_fn(params_local, x)
+        # Microbatch id at this device this tick; valid while 0 <= id < m.
+        mb = t - idx
+        valid = jnp.logical_and(mb >= 0, mb < m)
+        y = jnp.where(valid, y, jnp.zeros_like(y))
+        # Ring transfer: stage d -> d+1; the last stage's wrap-around to
+        # device 0 carries the FINISHED microbatch, captured below.
+        moved = lax.ppermute(y, axis, perm)
+        # Device 0 received the last stage's output for microbatch t-(n-1).
+        done_mb = t - (n - 1)
+        take = jnp.logical_and(idx == 0,
+                               jnp.logical_and(done_mb >= 0, done_mb < m))
+        slot = jnp.clip(done_mb, 0, m - 1)
+        updated = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(take, moved,
+                               lax.dynamic_index_in_dim(
+                                   outputs, slot, 0, keepdims=False)),
+            slot, 0)
+        return moved, updated
+
+    _, outputs = lax.fori_loop(0, m + n - 1, tick, (carry0, outputs0))
+    # Outputs accumulated on device 0's copy; replicate via psum of the
+    # masked buffer (every other device holds zeros there).
+    outputs = jnp.where(idx == 0, outputs, jnp.zeros_like(outputs))
+    return lax.psum(outputs, axis)
+
+
+def stage_params(stacked, axis: str = "pp"):
+    """Per-device code: pick this device's stage slice from a pytree whose
+    leaves are stacked [num_stages, ...]."""
+    i = lax.axis_index(axis)
+    return jax.tree_util.tree_map(
+        lambda w: lax.dynamic_index_in_dim(w, i, 0, keepdims=False),
+        stacked)
