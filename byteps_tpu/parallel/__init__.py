@@ -29,6 +29,9 @@ from byteps_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention_sharded,
 )
 from byteps_tpu.parallel.moe import moe_dispatch, moe_ffn  # noqa: F401
+from byteps_tpu.parallel.hierarchical import (  # noqa: F401
+    quantized_all_reduce,
+)
 from byteps_tpu.parallel.pipeline import gpipe, stage_params  # noqa: F401
 from byteps_tpu.parallel.tensor_parallel import (  # noqa: F401
     column_parallel,

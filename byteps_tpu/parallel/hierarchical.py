@@ -167,3 +167,84 @@ def tree_broadcast(tree, *, root: int = 0,
         lambda x: hierarchical_broadcast(
             x, root=root, ici_axis=ici_axis, dcn_axis=dcn_axis),
         tree)
+
+
+def _blockwise_quantize(x: jax.Array, block: int):
+    """int8-quantize with one f32 scale per ``block`` values (x is padded
+    to a block multiple by the caller). Returns (q[int8], scales[f32])."""
+    b = x.reshape(-1, block).astype(jnp.float32)
+    scale = jnp.max(jnp.abs(b), axis=1, keepdims=True) / 127.0
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(b / safe), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _blockwise_dequantize(q: jax.Array, scale: jax.Array) -> jax.Array:
+    return (q.astype(jnp.float32) * scale).reshape(-1)
+
+
+def quantized_all_reduce(
+    x: jax.Array,
+    *,
+    ici_axis: Optional[str] = "ici",
+    dcn_axis: Optional[str] = "dcn",
+    average: bool = True,
+    block: int = 256,
+) -> jax.Array:
+    """Hierarchical all-reduce with int8 blockwise-quantized ICI transport
+    (EQuARX-style, PAPERS.md: arXiv 2506.17615): ~4x the effective ICI
+    bandwidth of f32 (2x bf16) at ~1e-2 relative error per stage.
+
+    Per-device code under shard_map. The reduce-scatter becomes an
+    all-to-all of int8 chunks + per-block f32 scales with a local f32
+    summation, and the return all-gather ships int8 too. The dcn stage
+    stays exact (f32 psum): cross-slice bytes are the PS/codec layer's
+    job (byteps_tpu compression), and double quantization would compound
+    error. Use for bandwidth-bound steps where gradient noise tolerance
+    allows it; pair with error feedback at the optimizer level if needed.
+    """
+    ici = ici_axis if ici_axis and _axis_size(ici_axis) > 1 else None
+    dcn = dcn_axis if dcn_axis and _axis_size(dcn_axis) > 1 else None
+    denom = _axis_size(ici) * _axis_size(dcn)
+
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+
+    if ici is None:
+        if dcn is not None:
+            flat = lax.psum(flat, dcn)
+        if average and denom > 1:
+            flat = flat / denom
+        return flat.reshape(orig_shape).astype(orig_dtype)
+
+    k = _axis_size(ici)
+    pad = (-n) % (k * block)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    chunk = flat.shape[0] // k
+
+    # Stage 1: quantize per destination chunk, all-to-all, local f32 sum.
+    q, scale = _blockwise_quantize(flat, block)           # [nb, block]
+    q = q.reshape(k, chunk // block, block)
+    scale = scale.reshape(k, chunk // block, 1)
+    q_recv = lax.all_to_all(q, ici, split_axis=0, concat_axis=0,
+                            tiled=False)
+    s_recv = lax.all_to_all(scale, ici, split_axis=0, concat_axis=0,
+                            tiled=False)
+    shard = jnp.sum(q_recv.astype(jnp.float32) * s_recv, axis=0).reshape(-1)
+
+    # Stage 2: exact cross-slice reduction.
+    if dcn is not None:
+        shard = lax.psum(shard, dcn)
+    if average and denom > 1:
+        shard = shard / denom
+
+    # Stage 3: quantize the owned shard, all-gather, dequantize.
+    q2, s2 = _blockwise_quantize(shard, block)
+    q_all = lax.all_gather(q2, ici, axis=0, tiled=True)
+    s_all = lax.all_gather(s2, ici, axis=0, tiled=True)
+    out = _blockwise_dequantize(q_all, s_all)
+    if pad:
+        out = out[:n]
+    return out.reshape(orig_shape).astype(orig_dtype)
