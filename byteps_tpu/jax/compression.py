@@ -43,3 +43,7 @@ class Compression:
     # bfloat16 is the TPU-native half type: same exponent range as f32, so
     # gradient casts need no loss scaling — preferred over fp16 on TPU.
     bf16 = Compressor("bf16", lambda x: x.astype(jnp.bfloat16), _restore)
+    # int8: EQuARX-style blockwise-quantized collective transport (the
+    # whole reduce path changes, not just a cast) — push_pull dispatches
+    # to parallel.hierarchical.quantized_all_reduce when it sees this.
+    int8 = Compressor("int8_quant", _identity, lambda x, d: x.astype(d))

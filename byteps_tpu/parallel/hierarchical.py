@@ -248,3 +248,29 @@ def quantized_all_reduce(
     if pad:
         out = out[:n]
     return out.reshape(orig_shape).astype(orig_dtype)
+
+
+def tree_quantized_all_reduce(
+    tree,
+    *,
+    ici_axis: Optional[str] = "ici",
+    dcn_axis: Optional[str] = "dcn",
+    average: bool = True,
+    block: int = 256,
+):
+    """Fused pytree variant of quantized_all_reduce: one flat f32 buffer,
+    one quantized collective pair (tensor fusion, as tree_all_reduce)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    sizes = [l.size for l in leaves]
+    flat = jnp.concatenate(
+        [l.reshape(-1).astype(jnp.float32) for l in leaves])
+    flat = quantized_all_reduce(flat, ici_axis=ici_axis, dcn_axis=dcn_axis,
+                                average=average, block=block)
+    out, off = [], 0
+    for leaf, sz in zip(leaves, sizes):
+        out.append(flat[off:off + sz].reshape(leaf.shape)
+                   .astype(leaf.dtype))
+        off += sz
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -155,3 +155,21 @@ def test_topology_queries():
 def test_requires_init():
     with pytest.raises(RuntimeError):
         bps.size()
+
+
+def test_push_pull_int8_quantized_wire():
+    """Compression.int8 routes through the quantized collective and stays
+    within quantization tolerance of the exact mean."""
+    import numpy as _np
+
+    from byteps_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(dcn=2, ici=4))
+    bps.init(mesh=mesh)
+    rng = _np.random.default_rng(3)
+    g = jnp.asarray(rng.standard_normal((8, 200)), jnp.float32)
+    out = bps.push_pull({"g": g}, average=True,
+                        compression=bps.Compression.int8)["g"]
+    expect = _np.mean(_np.asarray(g), axis=0)
+    _np.testing.assert_allclose(_np.asarray(out), expect, rtol=0.05,
+                                atol=0.05)
