@@ -36,28 +36,54 @@ def _supports_flag(cxx: str, flag: str) -> bool:
     return probe.returncode == 0
 
 
-def build(force: bool = False, verbose: bool = True) -> str:
-    """Compile if sources are newer than the library. Returns the lib path."""
+def build(force: bool = False, verbose: bool = True,
+          sanitize: str = "") -> str:
+    """Compile if sources are newer than the library. Returns the lib path.
+
+    ``sanitize``: "address" or "thread" builds an instrumented variant
+    (libbyteps_core.asan.so / .tsan.so). The reference relies on CHECK
+    macros alone (SURVEY.md §5 "no TSAN/ASAN CI"); these builds are how
+    byteps_tpu races/UAFs get caught — an exit-order use-after-free in the
+    shutdown path was found exactly this way. Run with:
+
+        BPS_CORE_LIB=.../libbyteps_core.asan.so \
+        LD_PRELOAD=$(g++ -print-file-name=libasan.so) python ...
+    """
+    lib_path = LIB_PATH
+    if sanitize:
+        assert sanitize in ("address", "thread"), sanitize
+        suffix = {"address": ".asan.so", "thread": ".tsan.so"}[sanitize]
+        lib_path = LIB_PATH[:-3] + suffix
     srcs = [os.path.join(CSRC, s) for s in SOURCES]
     hdrs = [os.path.join(CSRC, h) for h in os.listdir(CSRC)
             if h.endswith(".h")]
-    if not force and os.path.exists(LIB_PATH):
-        lib_mtime = os.path.getmtime(LIB_PATH)
+    if not force and os.path.exists(lib_path):
+        lib_mtime = os.path.getmtime(lib_path)
         if all(os.path.getmtime(f) < lib_mtime for f in srcs + hdrs):
-            return LIB_PATH
+            return lib_path
 
     cxx = os.environ.get("CXX", "g++")
-    flags = ["-O3", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
-    for extra in ("-march=native", "-fopenmp"):
-        if _supports_flag(cxx, extra):
-            flags.append(extra)
-    cmd = [cxx, *flags, *srcs, "-o", LIB_PATH]
+    if sanitize:
+        flags = ["-O1", "-g", "-std=c++17", "-fPIC", "-shared", "-pthread",
+                 "-Wall", f"-fsanitize={sanitize}",
+                 "-fno-omit-frame-pointer"]
+    else:
+        flags = ["-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+                 "-Wall"]
+        for extra in ("-march=native", "-fopenmp"):
+            if _supports_flag(cxx, extra):
+                flags.append(extra)
+    cmd = [cxx, *flags, *srcs, "-o", lib_path]
     if verbose:
         print("[byteps_tpu.core.build]", " ".join(cmd))
     subprocess.run(cmd, check=True)
-    return LIB_PATH
+    return lib_path
 
 
 if __name__ == "__main__":
-    build(force="--force" in sys.argv)
-    print(LIB_PATH)
+    san = ""
+    if "--asan" in sys.argv:
+        san = "address"
+    elif "--tsan" in sys.argv:
+        san = "thread"
+    print(build(force="--force" in sys.argv, sanitize=san))

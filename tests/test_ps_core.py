@@ -150,3 +150,30 @@ def test_worker_exit_without_shutdown():
     worker = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
                            "_no_shutdown_worker.py")
     run_topology(2, 1, worker, timeout=120)
+
+
+@pytest.mark.ps
+def test_topology_clean_under_asan():
+    """The basic sum topology plus a no-shutdown worker run clean under
+    AddressSanitizer (SURVEY.md §5: the reference has no sanitizer
+    coverage; this is how the exit-order use-after-free was caught)."""
+    import subprocess
+
+    from byteps_tpu.core.build import build
+
+    gxx = os.environ.get("CXX", "g++")
+    libasan = subprocess.run(
+        [gxx, "-print-file-name=libasan.so"],
+        capture_output=True, text=True).stdout.strip()
+    if not libasan or not os.path.isabs(libasan):
+        pytest.skip("libasan not available")
+    lib = build(sanitize="address", verbose=False)
+    extra = {
+        "BPS_CORE_LIB": lib,
+        "LD_PRELOAD": libasan,
+        "ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
+    }
+    run_topology(2, 1, WORKER, mode="basic", extra=extra, timeout=120)
+    nsd = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "_no_shutdown_worker.py")
+    run_topology(2, 1, nsd, extra=extra, timeout=120)
