@@ -214,6 +214,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "1: one controller drives all local chips)")
     p.add_argument("--numa", action="store_true",
                    help="bind worker processes round-robin across NUMA nodes")
+    p.add_argument("--restarts", type=int, default=0,
+                   help="--local mode: relaunch the whole fleet up to N "
+                        "times after a failed run (elastic-ish recovery: "
+                        "pair the training script with checkpoint/resume "
+                        "so restarts continue from the last step)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="worker command, e.g. python train.py")
     args = p.parse_args(argv)
@@ -224,8 +229,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.local:
         if not command:
             p.error("--local requires a worker command")
-        return launch_local_fleet(command, args.local, args.num_servers,
-                                  args.port, dict(os.environ), numa=args.numa)
+        rc = launch_local_fleet(command, args.local, args.num_servers,
+                                args.port, dict(os.environ), numa=args.numa)
+        for attempt in range(args.restarts):
+            if rc == 0:
+                break
+            print(f"bpslaunch: fleet failed (exit {rc}); restart "
+                  f"{attempt + 1}/{args.restarts}", file=sys.stderr)
+            rc = launch_local_fleet(command, args.local, args.num_servers,
+                                    args.port, dict(os.environ),
+                                    numa=args.numa)
+        return rc
 
     role = os.environ.get("DMLC_ROLE", "worker").lower()
     if role in ("scheduler", "server"):

@@ -72,3 +72,34 @@ def test_local_fleet_end_to_end():
                    sys.executable, WORKER,
                    env=_env(BPS_TEST_MODE="basic"))
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+
+def test_restarts_rerun_failed_fleet(tmp_path):
+    """--restarts relaunches the fleet after a failure; a worker that
+    fails on its first life and succeeds on its second (via a marker
+    file) ends the job green — the checkpoint/resume recovery story."""
+    import subprocess
+    import sys
+
+    marker = tmp_path / "attempted"
+    code = (
+        "import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "import byteps_tpu.torch as bps\n"
+        "bps.init()\n"
+        "first = not os.path.exists(m)\n"
+        "open(m, 'a').write(str(bps.rank()))\n"
+        "bps.shutdown()\n"
+        "sys.exit(1 if first and bps is not None else 0)\n"
+    )
+    script = tmp_path / "flaky.py"
+    script.write_text(code)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "byteps_tpu.launcher", "--local", "1",
+         "--num-servers", "1", "--restarts", "2", "--",
+         sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "restart 1/2" in out.stderr
