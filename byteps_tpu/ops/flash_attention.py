@@ -37,8 +37,8 @@ except Exception:  # pragma: no cover
 _NEG_INF = -1e30
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-               scale: float, causal: bool, block_q: int, block_k: int,
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+               *, scale: float, causal: bool, block_q: int, block_k: int,
                seq_k: int):
     """One (bh, qi, ki) grid step of blockwise attention."""
     ki = pl.program_id(2)
@@ -97,8 +97,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     def _finish():
         # Fully-masked rows (query padding) have l == 0; guard the divide.
         l = l_ref[:, 0:1]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        # logsumexp per row (scaled-score space) for the backward pass;
+        # +LARGE for empty rows so exp(s - lse) underflows to exactly 0.
+        lse = jnp.where(l == 0.0, _NEG_INF * -1.0,
+                        m_ref[:, 0:1] + jnp.log(safe_l))
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _pad_to(x, multiple: int, axis: int):
@@ -137,7 +142,18 @@ def flash_attention(
                            interpret)
 
 
-def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
+def _to_bhsd(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _from_bhsd(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
+                    return_lse: bool = False):
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     if scale is None:
@@ -148,12 +164,9 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
     bq = min(block_q, max(s_q, 8))
     bk = min(block_k, max(s_k, 8))
 
-    def to_bhsd(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-
-    qq = _pad_to(to_bhsd(q), bq, axis=1)
-    kk = _pad_to(to_bhsd(k), bk, axis=1)
-    vv = _pad_to(to_bhsd(v), bk, axis=1)
+    qq = _pad_to(_to_bhsd(q), bq, axis=1)
+    kk = _pad_to(_to_bhsd(k), bk, axis=1)
+    vv = _pad_to(_to_bhsd(v), bk, axis=1)
     sq_p, sk_p = qq.shape[1], kk.shape[1]
 
     grid = (b * h, sq_p // bq, sk_p // bk)
@@ -166,7 +179,7 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
         _VMEM((bq, d), jnp.float32),    # acc
     ]
     vmem = pl.BlockSpec
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -177,31 +190,240 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
             vmem((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
                  memory_space=_VMEM),
         ],
-        out_specs=vmem((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
-                       memory_space=_VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        out_specs=[
+            vmem((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
+                 memory_space=_VMEM),
+            vmem((1, bq, 128), lambda bh, qi, ki: (bh, qi, 0),
+                 memory_space=_VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq_p, 128), jnp.float32),
+        ],
         scratch_shapes=scratch,
         interpret=interpret,
     )(qq, kk, vv)
-    out = out[:, :s_q].reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    out = _from_bhsd(out[:, :s_q], b, h)
+    if return_lse:
+        return out, lse  # lse stays padded [bh, sq_p, 128]
     return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
-                          interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                               interpret, return_lse=True)
+    return out, (q, k, v, out, lse)
+
+
+# Backward blocks are fixed smaller than the forward's: the bwd kernels
+# hold more live [bq, bk] f32 temporaries (p, dp, ds) in VMEM.
+_BWD_BQ = 256
+_BWD_BK = 512
+
+
+def _bwd_mask(q_start, k_start, bq, bk, seq_q, seq_k, causal):
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = jnp.logical_and(q_pos < seq_q, k_pos < seq_k)
+    if causal:
+        mask = jnp.logical_and(mask, q_pos >= k_pos)
+    return mask
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
+                      dq_acc, *, scale, causal, block_q, block_k,
+                      seq_q, seq_k):
+    """dQ = scale * sum_k [p * (dO V^T - D)] K; grid (bh, qi, ki)."""
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0:1]
+        dd = dd_ref[0][:, 0:1]
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        mask = _bwd_mask(q_start, k_start, block_q, block_k, seq_q, seq_k,
+                         causal)
+        p = jnp.where(mask, jnp.exp(sc - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dd) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                       dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                       block_q, block_k, seq_q, seq_k):
+    """dK = scale * sum_q ds^T Q;  dV = sum_q p^T dO; grid (bh, ki, qi)."""
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0:1]
+        dd = dd_ref[0][:, 0:1]
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        mask = _bwd_mask(q_start, k_start, block_q, block_k, seq_q, seq_k,
+                         causal)
+        p = jnp.where(mask, jnp.exp(sc - lse), 0.0)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dd) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(q_start + block_q - 1 >= k_start)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    """Backward via XLA recompute of the exact same attention math."""
-    from byteps_tpu.parallel.ring_attention import full_attention
+    """Pallas backward: blockwise recompute from (q, k, v, o, lse) — the
+    standard flash-attention backward, O(seq) memory like the forward."""
+    q, k, v, out, lse = res
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
 
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: full_attention(q_, k_, v_, causal=causal,
-                                          scale=scale), q, k, v)
-    return vjp(g)
+    bq = min(_BWD_BQ, max(s_q, 8))
+    bk = min(_BWD_BK, max(s_k, 8))
+
+    qq = _pad_to(_to_bhsd(q), bq, axis=1)
+    kk = _pad_to(_to_bhsd(k), bk, axis=1)
+    vv = _pad_to(_to_bhsd(v), bk, axis=1)
+    dd_o = _pad_to(_to_bhsd(g.astype(q.dtype)), bq, axis=1)
+    sq_p, sk_p = qq.shape[1], kk.shape[1]
+
+    # D_i = rowsum(dO * O), f32, broadcast to the 128-lane layout.
+    dvec = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                   axis=-1)                                  # [b, s, h]
+    dvec = dvec.transpose(0, 2, 1).reshape(b * h, s_q)
+    dvec = _pad_to(dvec, bq, axis=1)
+    dd = jnp.broadcast_to(dvec[:, :, None], (b * h, sq_p, 128))
+
+    # the forward's lse is padded with the FORWARD's bq; re-pad for bwd
+    lse = lse[:, :s_q]
+    lse = _pad_to(lse, bq, axis=1)
+
+    vmem = pl.BlockSpec
+    kw = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
+              seq_q=s_q, seq_k=s_k)
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, **kw),
+        grid=(b * h, sq_p // bq, sk_p // bk),
+        in_specs=[
+            vmem((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
+                 memory_space=_VMEM),
+            vmem((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
+                 memory_space=_VMEM),
+            vmem((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
+                 memory_space=_VMEM),
+            vmem((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
+                 memory_space=_VMEM),
+            vmem((1, bq, 128), lambda bh, qi, ki: (bh, qi, 0),
+                 memory_space=_VMEM),
+            vmem((1, bq, 128), lambda bh, qi, ki: (bh, qi, 0),
+                 memory_space=_VMEM),
+        ],
+        out_specs=vmem((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
+                       memory_space=_VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        scratch_shapes=[_VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qq, kk, vv, dd_o, lse, dd)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, **kw),
+        grid=(b * h, sk_p // bk, sq_p // bq),
+        in_specs=[
+            vmem((1, bq, d), lambda bh, ki, qi: (bh, qi, 0),
+                 memory_space=_VMEM),
+            vmem((1, bk, d), lambda bh, ki, qi: (bh, ki, 0),
+                 memory_space=_VMEM),
+            vmem((1, bk, d), lambda bh, ki, qi: (bh, ki, 0),
+                 memory_space=_VMEM),
+            vmem((1, bq, d), lambda bh, ki, qi: (bh, qi, 0),
+                 memory_space=_VMEM),
+            vmem((1, bq, 128), lambda bh, ki, qi: (bh, qi, 0),
+                 memory_space=_VMEM),
+            vmem((1, bq, 128), lambda bh, ki, qi: (bh, qi, 0),
+                 memory_space=_VMEM),
+        ],
+        out_specs=[
+            vmem((1, bk, d), lambda bh, ki, qi: (bh, ki, 0),
+                 memory_space=_VMEM),
+            vmem((1, bk, d), lambda bh, ki, qi: (bh, ki, 0),
+                 memory_space=_VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk_p, d), v.dtype),
+        ],
+        scratch_shapes=[_VMEM((bk, d), jnp.float32),
+                        _VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(qq, kk, vv, dd_o, lse, dd)
+
+    dq = _from_bhsd(dq[:, :s_q], b, h)
+    dk = _from_bhsd(dk[:, :s_k], b, h)
+    dv = _from_bhsd(dv[:, :s_k], b, h)
+    return dq, dk, dv
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
