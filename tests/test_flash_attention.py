@@ -111,3 +111,18 @@ def test_flash_gradients_cross_attention_shapes(rng):
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-4)
+
+
+def test_flash_gradients_causal_rectangular(rng):
+    """causal + seq_q != seq_k: block-skip predicates combined with
+    asymmetric q/k padding."""
+    q, _, _ = _qkv(rng, b=1, s=100, h=2, d=16)
+    _, k, v = _qkv(rng, b=1, s=260, h=2, d=16)
+
+    g1 = jax.grad(lambda *a: (flash_attention(*a, causal=True) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: (full_attention(*a, causal=True) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
