@@ -16,6 +16,7 @@ from functools import partial
 from typing import Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -115,6 +116,72 @@ def _make_ps_train_step(loss_fn, optimizer, mesh, axes, average, compression,
         return params, opt_state, loss
 
     return step
+
+
+def make_async_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    params,
+    *,
+    prefix: str = "aparam",
+):
+    """Asynchronous PS training (reference: BYTEPS_ENABLE_ASYNC,
+    server.cc async path): the SERVER holds the parameters as a
+    server-resident accumulator; each worker, at its own pace and with no
+    per-round barrier, computes a local update and pushes the DELTA, then
+    pulls whatever the parameters currently are — stale gradients by
+    design.
+
+    ``params`` is the initial pytree; call on every worker with identical
+    values BEFORE training (it seeds the server copy via ps_broadcast from
+    rank 0). Returns ``step(params, opt_state, batch) ->
+    (params, opt_state, loss)`` where the returned params are the freshly
+    pulled server state.
+    """
+    import numpy as np
+
+    from byteps_tpu.jax.ps import ps_broadcast
+
+    st = bps._st()
+    client = st.ps_client
+    if client is None:
+        raise RuntimeError(
+            "make_async_train_step needs PS mode (DMLC_NUM_SERVER>0)")
+
+    # Seed: rank 0's initial params become the server-resident copy —
+    # CMD_BCAST_PUSH initialises the async accumulator for THE SAME wire
+    # keys the step pushes deltas to, and everyone starts from the same
+    # values.
+    params = ps_broadcast(params, root_rank=0, prefix=prefix)
+
+    @jax.jit
+    def local_update(p, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, p)
+        return updates, opt_state, loss
+
+    leaves0, treedef = jax.tree_util.tree_flatten(params)
+    tids = [client.declare(f"{prefix}_{i}", leaf.size,
+                           np.dtype(leaf.dtype).name)
+            for i, leaf in enumerate(leaves0)]
+
+    def step(params, opt_state, batch):
+        updates, opt_state, loss = local_update(params, opt_state, batch)
+        up_leaves = jax.tree_util.tree_flatten(updates)[0]
+        staged = []
+        for tid, leaf in zip(tids, up_leaves):
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            h = client.push_pull(tid, arr, average=False, async_mode=True)
+            staged.append((h, arr))
+        fresh = []
+        for (h, arr), leaf in zip(staged, leaves0):
+            client.wait(h)
+            fresh.append(jnp.asarray(arr).reshape(leaf.shape)
+                         .astype(leaf.dtype))
+        return (jax.tree_util.tree_unflatten(treedef, fresh), opt_state,
+                loss)
+
+    return params, step
 
 
 def replicate(tree, mesh: Optional[Mesh] = None):

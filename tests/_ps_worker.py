@@ -20,6 +20,8 @@ def main() -> int:
         return jax_train_main()
     if mode == "jax_overlap":
         return jax_overlap_main()
+    if mode == "jax_async":
+        return jax_async_main()
     w = Worker.start()
     rank = w.worker_rank()
     nw = w.num_workers()
@@ -269,6 +271,50 @@ def jax_train_main() -> int:
     bps_jax.shutdown()
     print(f"worker {rank}: jax_train OK")
     return 0
+
+
+def jax_async_main() -> int:
+    """Async PS training (BYTEPS_ENABLE_ASYNC): no per-round barrier,
+    server-resident accumulator. Assert convergence, not bitwise parity —
+    staleness is the contract (reference: server.cc async mode)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    import byteps_tpu.jax as bps_jax
+    from byteps_tpu.config import get_config
+
+    cfg = get_config(reload=True)
+    assert cfg.use_ps and cfg.enable_async
+    bps_jax.init()
+    try:
+        from byteps_tpu.jax.training import make_async_train_step
+
+        rank = bps_jax._st().ps_client.worker_rank()
+
+        def loss_fn(params, batch):
+            x, y = batch
+            return jnp.mean((x @ params["w"] - y) ** 2)
+
+        prng = np.random.default_rng(11)
+        w_true = prng.standard_normal((6, 3)).astype(np.float32)
+        params = {"w": jnp.zeros((6, 3), jnp.float32)}
+        tx = optax.sgd(0.05)
+        params, step = make_async_train_step(loss_fn, tx, params)
+        opt_state = tx.init(params)
+        first = last = None
+        for i in range(40):
+            x = prng.standard_normal((16, 6)).astype(np.float32)
+            y = x @ w_true
+            params, opt_state, loss = step(params, opt_state, (x, y))
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+        assert last < first * 0.2, (first, last)
+        print(f"worker {rank}: jax_async OK ({first:.4f} -> {last:.4f})")
+        return 0
+    finally:
+        bps_jax.shutdown()
 
 
 def jax_overlap_main() -> int:

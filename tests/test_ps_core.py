@@ -133,6 +133,14 @@ def test_jax_ps_single_worker_force_distributed():
                         "BYTEPS_FORCE_DISTRIBUTED": "1"}, timeout=180)
 
 
+def test_jax_async_training_converges():
+    """BYTEPS_ENABLE_ASYNC through the full JAX PS path: stale gradients,
+    no per-round barrier, still converges (SURVEY.md §2.7 DP-async)."""
+    run_topology(2, 1, WORKER, mode="jax_async",
+                 extra={"BYTEPS_PS_MODE": "ps", "BYTEPS_ENABLE_ASYNC": "1"},
+                 timeout=180)
+
+
 def test_jax_overlapped_training_matches_single_process():
     """Hook-style per-layer push streaming (custom_vjp taps + io_callback,
     SURVEY.md §7 hard part #1) reproduces single-process numerics."""
