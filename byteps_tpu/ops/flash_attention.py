@@ -40,7 +40,7 @@ _NEG_INF = -1e30
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                *, scale: float, causal: bool, block_q: int, block_k: int,
-               seq_k: int):
+               seq_k: int, window: Optional[int] = None):
     # lse_ref is None for inference-only calls (no residual output).
     """One (bh, qi, ki) grid step of blockwise attention."""
     ki = pl.program_id(2)
@@ -71,6 +71,9 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             mask = jnp.logical_and(mask, q_pos >= k_pos)
+            if window is not None:
+                # sliding window: attend to the last `window` positions
+                mask = jnp.logical_and(mask, q_pos - k_pos < window)
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:, 0:1]             # [bq, 1]
@@ -89,7 +92,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
 
     if causal:
         # k_start/q_start are traced (program_id); predicate at runtime.
-        @pl.when(k_start <= q_start + block_q - 1)
+        live = k_start <= q_start + block_q - 1
+        if window is not None:
+            # skip blocks entirely left of every query's window
+            live = jnp.logical_and(
+                live, k_start + block_k - 1 >= q_start - (window - 1))
+
+        @pl.when(live)
         def _():
             _compute()
     else:
@@ -119,7 +128,7 @@ def _pad_to(x, multiple: int, axis: int):
     return jnp.pad(x, widths)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -129,6 +138,7 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention over [batch, seq, heads, head_dim] arrays.
 
@@ -136,13 +146,21 @@ def flash_attention(
     (~1.6x over XLA's fused attention; 128x128 was slower than XLA).
     Blocks clamp to the sequence length for short inputs.
 
+    ``window`` (requires ``causal``) restricts each query to the last
+    ``window`` positions — Mistral-style sliding-window attention; blocks
+    left of every query's window are skipped entirely, so compute scales
+    with ``seq * window`` instead of ``seq^2 / 2``.
+
     Exact softmax attention, O(seq) memory. ``interpret=None`` auto-selects
     interpret mode off-TPU (tests run the same kernel on CPU). Drop-in for
     ``byteps_tpu.parallel.full_attention``, including as the inner kernel
     of ``ulysses_attention(attn_fn=...)``.
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (sliding-window "
+                         "attention is a causal scheme)")
     return _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
-                           interpret)
+                           interpret, window=window)
 
 
 def _to_bhsd(x):
@@ -156,7 +174,8 @@ def _from_bhsd(x, b, h):
 
 
 def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
-                    return_lse: bool = False):
+                    return_lse: bool = False,
+                    window: Optional[int] = None):
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     if scale is None:
@@ -191,7 +210,7 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
                   memory_space=_VMEM)
     o_shape = jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype)
     common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
-                  seq_k=s_k)
+                  seq_k=s_k, window=window)
     if return_lse:
         out, lse = pl.pallas_call(
             functools.partial(_fa_kernel, **common),
@@ -229,9 +248,10 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
     return _from_bhsd(out[:, :s_q], b, h)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               window):
     out, lse = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
-                               interpret, return_lse=True)
+                               interpret, return_lse=True, window=window)
     return out, (q, k, v, out, lse)
 
 
@@ -241,18 +261,31 @@ _BWD_BQ = 256
 _BWD_BK = 512
 
 
-def _bwd_mask(q_start, k_start, bq, bk, seq_q, seq_k, causal):
+def _bwd_mask(q_start, k_start, bq, bk, seq_q, seq_k, causal, window):
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     mask = jnp.logical_and(q_pos < seq_q, k_pos < seq_k)
     if causal:
         mask = jnp.logical_and(mask, q_pos >= k_pos)
+        if window is not None:
+            mask = jnp.logical_and(mask, q_pos - k_pos < window)
     return mask
+
+
+def _bwd_live(q_start, k_start, bq, bk, causal, window):
+    """Block-level skip predicate shared by both backward kernels."""
+    if not causal:
+        return None
+    live = q_start + bq - 1 >= k_start
+    if window is not None:
+        live = jnp.logical_and(live,
+                               k_start + bk - 1 >= q_start - (window - 1))
+    return live
 
 
 def _bwd_recompute(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
                    q_start, k_start, *, scale, causal, block_q, block_k,
-                   seq_q, seq_k):
+                   seq_q, seq_k, window=None):
     """Shared dq/dkv block recompute: returns (p, ds, do_f32). The one
     place the score/probability/ds math lives, so the two backward
     kernels cannot silently diverge."""
@@ -266,7 +299,7 @@ def _bwd_recompute(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
     mask = _bwd_mask(q_start, k_start, block_q, block_k, seq_q, seq_k,
-                     causal)
+                     causal, window)
     p = jnp.where(mask, jnp.exp(sc - lse), 0.0)
     dp = jax.lax.dot_general(
         do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
@@ -277,7 +310,7 @@ def _bwd_recompute(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
                       dq_acc, *, scale, causal, block_q, block_k,
-                      seq_q, seq_k):
+                      seq_q, seq_k, window=None):
     """dQ = scale * sum_k [p * (dO V^T - D)] K; grid (bh, qi, ki)."""
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
@@ -293,18 +326,19 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
         _, ds, _ = _bwd_recompute(
             q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, q_start, k_start,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            seq_q=seq_q, seq_k=seq_k)
+            seq_q=seq_q, seq_k=seq_k, window=window)
         k = k_ref[0]
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(k_start <= q_start + block_q - 1)
+    live = _bwd_live(q_start, k_start, block_q, block_k, causal, window)
+    if live is None:
+        _compute()
+    else:
+        @pl.when(live)
         def _():
             _compute()
-    else:
-        _compute()
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -313,7 +347,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
                        dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                       block_q, block_k, seq_q, seq_k):
+                       block_q, block_k, seq_q, seq_k, window=None):
     """dK = scale * sum_q ds^T Q;  dV = sum_q p^T dO; grid (bh, ki, qi)."""
     ki, qi = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
@@ -330,7 +364,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         p, ds, do = _bwd_recompute(
             q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, q_start, k_start,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            seq_q=seq_q, seq_k=seq_k)
+            seq_q=seq_q, seq_k=seq_k, window=window)
         q = q_ref[0]
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -339,12 +373,13 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(q_start + block_q - 1 >= k_start)
+    live = _bwd_live(q_start, k_start, block_q, block_k, causal, window)
+    if live is None:
+        _compute()
+    else:
+        @pl.when(live)
         def _():
             _compute()
-    else:
-        _compute()
 
     @pl.when(qi == nq - 1)
     def _finish():
@@ -352,7 +387,8 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res,
+               g):
     """Pallas backward: blockwise recompute from (q, k, v, o, lse) — the
     standard flash-attention backward, O(seq) memory like the forward."""
     q, k, v, out, lse = res
@@ -384,7 +420,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 
     vmem = pl.BlockSpec
     kw = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
-              seq_q=s_q, seq_k=s_k)
+              seq_q=s_q, seq_k=s_k, window=window)
 
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, **kw),

@@ -126,3 +126,40 @@ def test_flash_gradients_causal_rectangular(rng):
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-4)
+
+
+def test_flash_sliding_window_matches_masked_reference(rng):
+    """window=w equals full attention with an explicit band mask, forward
+    and gradients."""
+    b, s, h, d, w = 1, 300, 2, 16, 64
+    q, k, v = _qkv(rng, b=b, s=s, h=h, d=d)
+
+    def ref(q, k, v):
+        scale = 1.0 / (d ** 0.5)
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        pos_q = jnp.arange(s)[:, None]
+        pos_k = jnp.arange(s)[None, :]
+        mask = (pos_q >= pos_k) & (pos_q - pos_k < w)
+        sc = jnp.where(mask[None, None], sc, -1e30)
+        p = jax.nn.softmax(sc, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    got = flash_attention(q, k, v, True, None, 64, 64, None, w)
+    want = ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+    g1 = jax.grad(lambda *a: (flash_attention(
+        *a, True, None, 64, 64, None, w) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: (ref(*a) ** 2).sum(), argnums=(0, 1, 2))(q, k,
+                                                                      v)
+    for a_, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a_), np.asarray(b_),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_flash_window_requires_causal(rng):
+    q, k, v = _qkv(rng, b=1, s=32, h=1, d=16)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, False, None, 16, 16, None, 8)
