@@ -33,6 +33,12 @@ from byteps_tpu.parallel.hierarchical import (  # noqa: F401
     quantized_all_reduce,
 )
 from byteps_tpu.parallel.pipeline import gpipe, stage_params  # noqa: F401
+from byteps_tpu.parallel.zero import (  # noqa: F401
+    make_zero_train_step,
+    zero_apply,
+    zero_init,
+    zero_init_sharded,
+)
 from byteps_tpu.parallel.tensor_parallel import (  # noqa: F401
     column_parallel,
     row_parallel,

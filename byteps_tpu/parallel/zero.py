@@ -1,0 +1,182 @@
+"""ZeRO-style sharded optimizer state over the data-parallel mesh.
+
+Beyond-reference scope (SURVEY.md §2.7: BytePS has no optimizer sharding —
+its servers hold aggregation buffers only). TPU-first shape: flatten the
+parameter pytree into ONE fused buffer, reduce-scatter gradients so each
+device owns 1/n of them (ZeRO-2 communication: same bytes as an
+all-reduce's first half), update ONLY the owned shard with optimizer
+state allocated for that shard alone (ZeRO-1 memory: optimizer state
+divided by the axis size), then all-gather the updated parameters.
+
+Exactness: elementwise optimizers (SGD/momentum/Adam/AdamW/...) act
+per-parameter, so the sharded update is bit-identical to the unsharded
+one — verified against the dense step in tests. Optimizers that couple
+elements across the tree (e.g. global-norm clipping) need the coupling
+computed globally first; compose with ``optax.clip_by_global_norm`` OUTSIDE
+this step or psum the norm yourself.
+
+Per-device code for use under ``jax.shard_map`` over axis ``axis``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+
+def _flatten(tree) -> Tuple[jax.Array, list, list, "jax.tree_util.PyTreeDef"]:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    flat = jnp.concatenate([l.reshape(-1).astype(jnp.float32)
+                            for l in leaves])
+    shapes = [l.shape for l in leaves]
+    dtypes = [l.dtype for l in leaves]
+    return flat, shapes, dtypes, treedef
+
+
+def _unflatten(flat, shapes, dtypes, treedef):
+    out, off = [], 0
+    for shape, dtype in zip(shapes, dtypes):
+        n = 1
+        for s in shape:
+            n *= s
+        out.append(flat[off:off + n].reshape(shape).astype(dtype))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def zero_init(params, optimizer: optax.GradientTransformation,
+              axis: str = "ici"):
+    """Per-device code: initialise THIS device's optimizer-state shard.
+
+    Returns ``(opt_state_shard, pad)`` where ``pad`` is the flat-buffer
+    padding (pass both to ``zero_step``)."""
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    flat, _, _, _ = _flatten(params)
+    pad = (-flat.shape[0]) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    shard_len = flat.shape[0] // n
+    my = lax.dynamic_slice_in_dim(flat, idx * shard_len, shard_len)
+    return optimizer.init(my), pad
+
+
+def zero_apply(params, grads, opt_state_shard,
+               optimizer: optax.GradientTransformation,
+               *, axis: str = "ici", average: bool = True):
+    """Per-device code: one sharded-optimizer update.
+
+    ``grads`` are this device's LOCAL gradients (pre-reduction); the
+    reduce-scatter of the fused gradient buffer is the communication
+    equivalent of the all-reduce's first half. Returns
+    ``(new_params, new_opt_state_shard)``.
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    flat_p, shapes, dtypes, treedef = _flatten(params)
+    flat_g, _, _, _ = _flatten(grads)
+    pad = (-flat_p.shape[0]) % n
+    if pad:
+        z = jnp.zeros((pad,), jnp.float32)
+        flat_p = jnp.concatenate([flat_p, z])
+        flat_g = jnp.concatenate([flat_g, z])
+    shard_len = flat_p.shape[0] // n
+
+    g_shard = lax.psum_scatter(flat_g, axis, scatter_dimension=0,
+                               tiled=True)
+    if average:
+        g_shard = g_shard / n
+    p_shard = lax.dynamic_slice_in_dim(flat_p, idx * shard_len, shard_len)
+    updates, opt_state_shard = optimizer.update(g_shard, opt_state_shard,
+                                                p_shard)
+    p_shard = optax.apply_updates(p_shard, updates)
+    flat_new = lax.all_gather(p_shard, axis, axis=0, tiled=True)
+    if pad:
+        flat_new = flat_new[:-pad]
+    return _unflatten(flat_new, shapes, dtypes, treedef), opt_state_shard
+
+
+def make_zero_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh=None,
+    *,
+    axis: Optional[str] = None,
+    donate: bool = True,
+):
+    """Build a jitted DP step with ZeRO-sharded optimizer state.
+
+    ``step(params, opt_state_shard, batch) ->
+    (params, opt_state_shard, loss)`` — same contract as
+    ``make_train_step`` but ``opt_state_shard`` comes from
+    ``zero_init_sharded`` and is 1/axis_size the size. The batch is
+    sharded over ALL mesh axes; optimizer state shards over ``axis``
+    (default: the innermost/ici axis).
+    """
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    import byteps_tpu.jax as bps
+    from byteps_tpu.jax._compat import shard_map as _shard_map
+
+    mesh = mesh or bps.mesh()
+    cfg = bps._st().config
+    batch_axes = tuple(a for a in (cfg.dcn_axis, cfg.ici_axis)
+                       if a in mesh.axis_names)
+    shard_axis = axis or batch_axes[-1]
+    other_axes = tuple(a for a in batch_axes if a != shard_axis)
+
+    @partial(_shard_map, mesh=mesh,
+             in_specs=(P(), P(shard_axis), P(batch_axes)),
+             out_specs=(P(), P(shard_axis), P()),
+             check_vma=False)
+    def _step(params, opt_state_shard, batch):
+        opt_state_shard = jax.tree_util.tree_map(
+            lambda x: x[0], opt_state_shard)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        # fold the non-sharding axes in first (plain mean), then the
+        # sharded axis via the fused reduce-scatter inside zero_apply
+        for ax in other_axes:
+            grads = jax.tree_util.tree_map(
+                lambda g, a=ax: lax.pmean(g, a), grads)
+            loss = lax.pmean(loss, ax)
+        params, opt_state_shard = zero_apply(
+            params, grads, opt_state_shard, optimizer, axis=shard_axis)
+        loss = lax.pmean(loss, shard_axis)
+        return params, jax.tree_util.tree_map(
+            lambda x: x[None], opt_state_shard), loss
+
+    jit_kwargs = {"donate_argnums": (0, 1)} if donate else {}
+    return jax.jit(_step, **jit_kwargs)
+
+
+def zero_init_sharded(params, optimizer: optax.GradientTransformation,
+                      mesh=None, *, axis: Optional[str] = None):
+    """Host-level: build the sharded optimizer state for
+    ``make_zero_train_step`` (stacked over the shard axis)."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    import byteps_tpu.jax as bps
+    from byteps_tpu.jax._compat import shard_map as _shard_map
+
+    mesh = mesh or bps.mesh()
+    cfg = bps._st().config
+    batch_axes = tuple(a for a in (cfg.dcn_axis, cfg.ici_axis)
+                       if a in mesh.axis_names)
+    shard_axis = axis or batch_axes[-1]
+
+    @jax.jit
+    @partial(_shard_map, mesh=mesh, in_specs=(P(),),
+             out_specs=P(shard_axis), check_vma=False)
+    def _init(p):
+        state, _pad = zero_init(p, optimizer, axis=shard_axis)
+        return jax.tree_util.tree_map(lambda x: x[None], state)
+
+    return _init(params)
