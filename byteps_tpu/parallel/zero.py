@@ -9,11 +9,16 @@ state allocated for that shard alone (ZeRO-1 memory: optimizer state
 divided by the axis size), then all-gather the updated parameters.
 
 Exactness: elementwise optimizers (SGD/momentum/Adam/AdamW/...) act
-per-parameter, so the sharded update is bit-identical to the unsharded
-one — verified against the dense step in tests. Optimizers that couple
-elements across the tree (e.g. global-norm clipping) need the coupling
-computed globally first; compose with ``optax.clip_by_global_norm`` OUTSIDE
-this step or psum the norm yourself.
+per-parameter, so for float32 parameters the sharded update is
+bit-identical to the unsharded one — verified against the dense step in
+tests. Lower-precision params follow the master-weights recipe instead:
+the flat buffer, optimizer state, and update run in float32 and params
+are cast back afterwards (more accurate than a bf16-state dense step,
+not bitwise equal to it; the all-gather also ships f32). Optimizers that
+couple elements across the tree (e.g. global-norm clipping) need the
+coupling computed globally first; compose with
+``optax.clip_by_global_norm`` OUTSIDE this step or psum the norm
+yourself.
 
 Per-device code for use under ``jax.shard_map`` over axis ``axis``.
 """
@@ -50,10 +55,9 @@ def _unflatten(flat, shapes, dtypes, treedef):
 
 def zero_init(params, optimizer: optax.GradientTransformation,
               axis: str = "ici"):
-    """Per-device code: initialise THIS device's optimizer-state shard.
-
-    Returns ``(opt_state_shard, pad)`` where ``pad`` is the flat-buffer
-    padding (pass both to ``zero_step``)."""
+    """Per-device code: initialise THIS device's optimizer-state shard
+    (state over the f32 flat shard; padding is recomputed by
+    ``zero_apply``)."""
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     flat, _, _, _ = _flatten(params)
@@ -62,7 +66,7 @@ def zero_init(params, optimizer: optax.GradientTransformation,
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
     shard_len = flat.shape[0] // n
     my = lax.dynamic_slice_in_dim(flat, idx * shard_len, shard_len)
-    return optimizer.init(my), pad
+    return optimizer.init(my)
 
 
 def zero_apply(params, grads, opt_state_shard,
@@ -126,8 +130,20 @@ def make_zero_train_step(
 
     mesh = mesh or bps.mesh()
     cfg = bps._st().config
+    if cfg.use_ps:
+        raise NotImplementedError(
+            "make_zero_train_step covers collective mode only; in PS mode "
+            "the cross-host reduction rides the DCN leg, which this step "
+            "does not drive — use make_train_step, or shard manually with "
+            "zero_apply inside your own step")
     batch_axes = tuple(a for a in (cfg.dcn_axis, cfg.ici_axis)
                        if a in mesh.axis_names)
+    if not batch_axes:
+        raise ValueError(
+            f"mesh axes {mesh.axis_names} do not include the configured "
+            f"dcn/ici axes ({cfg.dcn_axis!r}, {cfg.ici_axis!r}); build the "
+            "mesh with byteps_tpu.parallel.mesh.build_mesh or init with "
+            "matching axis names")
     shard_axis = axis or batch_axes[-1]
     other_axes = tuple(a for a in batch_axes if a != shard_axis)
 
@@ -170,13 +186,17 @@ def zero_init_sharded(params, optimizer: optax.GradientTransformation,
     cfg = bps._st().config
     batch_axes = tuple(a for a in (cfg.dcn_axis, cfg.ici_axis)
                        if a in mesh.axis_names)
+    if not batch_axes:
+        raise ValueError(
+            f"mesh axes {mesh.axis_names} do not include the configured "
+            f"dcn/ici axes ({cfg.dcn_axis!r}, {cfg.ici_axis!r})")
     shard_axis = axis or batch_axes[-1]
 
     @jax.jit
     @partial(_shard_map, mesh=mesh, in_specs=(P(),),
              out_specs=P(shard_axis), check_vma=False)
     def _init(p):
-        state, _pad = zero_init(p, optimizer, axis=shard_axis)
+        state = zero_init(p, optimizer, axis=shard_axis)
         return jax.tree_util.tree_map(lambda x: x[None], state)
 
     return _init(params)
