@@ -1,0 +1,91 @@
+"""Long-context LLaMA training: Pallas flash attention + remat + DP.
+
+Demonstrates the long-context path (SURVEY.md §5 notes the reference has
+none — this is byteps_tpu scope beyond parity): sliding-window flash
+attention with O(seq) memory, per-block rematerialisation, and the
+standard data-parallel framework step.
+
+    python example/jax/train_llama_long_context.py --seq-len 4096
+    # multi-host: python -m byteps_tpu.launcher --local 2 --num-servers 1 -- \
+    #   python example/jax/train_llama_long_context.py --seq-len 1024
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=4096)
+    p.add_argument("--batch-size", type=int, default=0,
+                   help="global batch (default: 1 per chip)")
+    p.add_argument("--window", type=int, default=0,
+                   help="sliding attention window (0 = full causal)")
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--vocab", type=int, default=4096)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--d-model", type=int, default=512)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=4)
+    p.add_argument("--fp32", action="store_true")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import byteps_tpu.jax as bps
+    from byteps_tpu.jax.training import (make_train_step, replicate,
+                                         shard_batch)
+    from byteps_tpu.models import LlamaModel
+    from byteps_tpu.models.transformer import lm_loss
+
+    bps.init()
+    n_dev = bps.device_count()
+    batch = args.batch_size or n_dev
+    dtype = jnp.float32 if args.fp32 else jnp.bfloat16
+    attn_impl = "flash" if jax.default_backend() == "tpu" else "full"
+
+    model = LlamaModel(
+        vocab_size=args.vocab, num_layers=args.layers,
+        d_model=args.d_model, num_heads=args.heads,
+        num_kv_heads=args.kv_heads, mlp_dim=args.d_model * 3,
+        dtype=dtype, attn_impl=attn_impl, remat=True)
+    if args.window and attn_impl != "flash":
+        raise SystemExit("--window needs the flash backend (run on TPU)")
+
+    rng = np.random.default_rng(bps.rank())
+    toks = jnp.asarray(rng.integers(0, args.vocab,
+                                    (batch, args.seq_len)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks[:1, :128])
+    tx = optax.adamw(3e-4)
+
+    def loss_fn(p, batch_):
+        return lm_loss(model.apply(p, batch_), batch_)
+
+    step = make_train_step(loss_fn, tx, bps.mesh())
+    p_r = replicate(params)
+    o_r = replicate(tx.init(params))
+    parts = shard_batch(toks)
+
+    p_r, o_r, loss = step(p_r, o_r, parts)   # compile
+    float(np.asarray(loss))   # full sync (block_until_ready can return at
+                              # dispatch on tunneled platforms)
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        p_r, o_r, loss = step(p_r, o_r, parts)
+        if i == args.steps - 1:
+            final = float(np.asarray(loss))  # forces completion
+    dt = time.perf_counter() - t0
+    if bps.rank() == 0:
+        tok_s = batch * args.seq_len * args.steps / dt
+        print(f"attn={attn_impl} seq={args.seq_len} window={args.window}: "
+              f"{tok_s:,.0f} tokens/sec, final loss {final:.4f}")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -129,3 +129,11 @@ def test_torch_mnist_under_launcher():
     assert "final accuracy" in out.stdout
     acc = float(out.stdout.strip().split("final accuracy:")[-1])
     assert acc > 0.5, out.stdout
+
+
+def test_llama_long_context_example():
+    out = _run(os.path.join(EX, "jax", "train_llama_long_context.py"),
+               "--seq-len", "256", "--steps", "2", "--layers", "2",
+               "--d-model", "64", "--heads", "4", "--kv-heads", "2",
+               "--vocab", "512", "--fp32")
+    assert "tokens/sec" in out
