@@ -364,7 +364,9 @@ def _jax_overlap_body() -> int:
         "w2": jnp.asarray(prng.standard_normal((8, 3)), jnp.float32) * 0.4,
     }
     tx = optax.sgd(0.1)
-    step = make_overlapped_train_step(loss_fn, tx)
+    comp = os.environ.get("BPS_OVERLAP_COMPRESSION") or None
+    step = make_overlapped_train_step(loss_fn, tx,
+                                      compression_config=comp)
     params = jax.tree_util.tree_map(jnp.array, params0)
     opt_state = tx.init(params)
     per = 8
@@ -391,10 +393,17 @@ def _jax_overlap_body() -> int:
         gx = ref_prng.standard_normal((nw * per, 6)).astype(np.float32)
         gy = gx[:, :3] * 2.0
         ref_params, ref_state = ref_step(ref_params, ref_state, (gx, gy))
-    for k in params:
-        np.testing.assert_allclose(
-            np.asarray(params[k]), np.asarray(ref_params[k]),
-            rtol=2e-4, atol=2e-5)
+    if comp:
+        # lossy codec + error feedback: same trajectory, looser bound
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(params[k]), np.asarray(ref_params[k]),
+                rtol=0.5, atol=0.2)
+    else:
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(params[k]), np.asarray(ref_params[k]),
+                rtol=2e-4, atol=2e-5)
     print(f"worker {rank}: jax_overlap OK")
     return 0
 

@@ -151,6 +151,16 @@ def test_jax_overlapped_training_matches_single_process():
                  timeout=180)
 
 
+def test_jax_overlapped_training_with_compression():
+    """Per-layer overlap composed with the C-core codec layer (topk + error
+    feedback on the streamed pushes)."""
+    run_topology(2, 1, WORKER, mode="jax_overlap",
+                 extra={"BYTEPS_PS_MODE": "ps", "XLA_FLAGS": "",
+                        "BPS_OVERLAP_COMPRESSION":
+                            "type=topk;k=64;ef=vanilla"},
+                 timeout=180)
+
+
 def test_worker_exit_without_shutdown():
     """A worker that never calls shutdown() must still tear down cleanly
     at process exit (C++ Global destructor ordering regression)."""
