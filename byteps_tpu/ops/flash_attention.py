@@ -40,8 +40,11 @@ _NEG_INF = -1e30
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                *, scale: float, causal: bool, block_q: int, block_k: int,
-               seq_k: int, window: Optional[int] = None):
+               seq_k: int, window: Optional[int] = None,
+               nk_total: Optional[int] = None):
     # lse_ref is None for inference-only calls (no residual output).
+    # nk_total set => restricted-window grid: the third grid dim walks only
+    # the ~window/block_k live k blocks per q block (see _window_kv_index).
     """One (bh, qi, ki) grid step of blockwise attention."""
     ki = pl.program_id(2)
     qi = pl.program_id(1)
@@ -54,7 +57,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     q_start = qi * block_q
-    k_start = ki * block_k
+    if nk_total is None:
+        k_start = ki * block_k
+    else:
+        # real (unclamped) k block this step serves; duplicates from the
+        # index-map clamp are skipped via the k_idx bound below
+        k_idx = _window_start_block(q_start, window, block_k) + ki
+        k_start = k_idx * block_k
 
     def _compute():
         q = q_ref[0]                       # [block_q, d]
@@ -97,6 +106,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             # skip blocks entirely left of every query's window
             live = jnp.logical_and(
                 live, k_start + block_k - 1 >= q_start - (window - 1))
+        if nk_total is not None:
+            live = jnp.logical_and(live, k_start < nk_total * block_k)
 
         @pl.when(live)
         def _():
@@ -116,6 +127,18 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             lse = jnp.where(l == 0.0, _NEG_INF * -1.0,
                             m_ref[:, 0:1] + jnp.log(safe_l))
             lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _window_start_block(q_start, window, block_k):
+    """First k block that can be inside [q_start - window + 1, ...]."""
+    return jnp.maximum((q_start - (window - 1)) // block_k, 0)
+
+
+def _window_live_blocks(window: int, block_q: int, block_k: int,
+                        nk: int) -> int:
+    """Static count of k blocks a q block can touch under the window."""
+    span = window + block_q - 1
+    return min(nk, span // block_k + 2)
 
 
 def _pad_to(x, multiple: int, axis: int):
@@ -191,7 +214,23 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
     vv = _pad_to(_to_bhsd(v), bk, axis=1)
     sq_p, sk_p = qq.shape[1], kk.shape[1]
 
-    grid = (b * h, sq_p // bq, sk_p // bk)
+    nk = sk_p // bk
+    if window is not None:
+        # visit only the live k blocks per q block: grid work (and the
+        # BlockSpec K/V prefetches) scale with seq*window, not seq^2
+        nkg = _window_live_blocks(window, bq, bk, nk)
+
+        def kv_index(bh, qi, ki):
+            return (bh,
+                    jnp.clip(_window_start_block(qi * bq, window, bk) + ki,
+                             0, nk - 1), 0)
+    else:
+        nkg = nk
+
+        def kv_index(bh, qi, ki):
+            return (bh, ki, 0)
+
+    grid = (b * h, sq_p // bq, nkg)
     scratch = [
         _VMEM((bq, 128), jnp.float32),  # m (value in lane 0)
         _VMEM((bq, 128), jnp.float32),  # l (value in lane 0)
@@ -201,16 +240,15 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret,
     in_specs = [
         vmem((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
              memory_space=_VMEM),
-        vmem((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
-             memory_space=_VMEM),
-        vmem((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
-             memory_space=_VMEM),
+        vmem((1, bk, d), kv_index, memory_space=_VMEM),
+        vmem((1, bk, d), kv_index, memory_space=_VMEM),
     ]
     o_spec = vmem((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
                   memory_space=_VMEM)
     o_shape = jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype)
     common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
-                  seq_k=s_k, window=window)
+                  seq_k=s_k, window=window,
+                  nk_total=nk if window is not None else None)
     if return_lse:
         out, lse = pl.pallas_call(
             functools.partial(_fa_kernel, **common),
