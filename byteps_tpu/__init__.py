@@ -11,22 +11,28 @@ launcher.
 Layout (capability parity with the reference's layer map, SURVEY.md §1):
 
 - ``byteps_tpu.config``     — env-var config system (docs/env.md parity).
-- ``byteps_tpu.topology``   — roles, ranks, mesh construction.
 - ``byteps_tpu.partition``  — tensor → partition slicing + key assignment.
-- ``byteps_tpu.core``       — C++ runtime (DCN van, PS server, CPU reducer,
-                              priority scheduler) + ctypes bindings.
-- ``byteps_tpu.jax``        — the JAX framework plugin (init/push_pull/
-                              DistributedOptimizer/broadcast_parameters);
-                              the equivalent of the reference's byteps/torch.
-- ``byteps_tpu.parallel``   — mesh/sharding utilities: hierarchical DP,
-                              ring-attention sequence parallelism, TP/PP/EP.
-- ``byteps_tpu.ops``        — Pallas TPU kernels for hot ops.
-- ``byteps_tpu.compression``— gradient compression plugin registry
-                              (onebit/topk/randomk/dithering + error
-                              feedback + momentum), JAX-native codecs.
-- ``byteps_tpu.models``     — flax model zoo used by examples/benchmarks.
-- ``byteps_tpu.server``     — ``import byteps_tpu.server`` runs a CPU PS
-                              (reference: byteps/server/__init__.py).
+- ``byteps_tpu.core``       — C++ runtime (DCN van, postoffice, PS server,
+                              CPU reducer, priority scheduler, compression
+                              codecs) + ctypes bindings (core/ffi.py).
+- ``byteps_tpu.jax``        — the flagship JAX plugin (init/push_pull/
+                              DistributedOptimizer/broadcast_parameters,
+                              collective + PS modes, per-layer overlap,
+                              sync/async/flax/haiku step builders).
+- ``byteps_tpu.torch`` / ``.tensorflow`` / ``.keras`` / ``.mxnet`` —
+                              Horovod-compatible framework plugins.
+- ``byteps_tpu.parallel``   — mesh construction, hierarchical DP (+ int8
+                              quantized), ring/Ulysses sequence parallel,
+                              TP, GPipe PP, MoE EP, ZeRO sharding.
+- ``byteps_tpu.ops``        — Pallas TPU kernels (flash attention fwd/bwd,
+                              sliding window).
+- ``byteps_tpu.models``     — flax model zoo (ResNet/VGG/BERT/GPT-2/LLaMA/
+                              MoE) used by examples/benchmarks.
+- ``byteps_tpu.utils``      — checkpoint/resume (orbax), trace timeline.
+- ``byteps_tpu.callbacks``  — Keras-style callbacks for JAX loops.
+- ``byteps_tpu.server``     — ``python -m byteps_tpu.server`` runs a CPU PS
+                              or the scheduler (reference:
+                              byteps/server/__init__.py).
 - ``byteps_tpu.launcher``   — ``bpslaunch``-style multi-role launcher.
 """
 
