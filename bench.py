@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import statistics
 import time
 
 
@@ -64,9 +65,9 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=0, help="global batch "
                    "(default: 64 per chip; bert: 8 per chip)")
-    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--steps", type=int, default=50)
     p.add_argument("--warmup", type=int, default=5)
-    p.add_argument("--repeats", type=int, default=3,
+    p.add_argument("--repeats", type=int, default=5,
                    help="alternating best-of repeats per path (drift guard)")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--model", choices=["resnet50", "bert"],
@@ -147,7 +148,10 @@ def main() -> None:
     # --- byteps_tpu path ---
     bps.init()
     mesh = bps.mesh()
-    step = make_flax_train_step(model.apply, tx, mesh)
+    # donate=False: the plain baseline doesn't donate either, and on the
+    # tunneled PJRT platform donation measurably costs ~0.5-1% — match
+    # the baseline's buffer discipline for an apples-to-apples ratio.
+    step = make_flax_train_step(model.apply, tx, mesh, donate=False)
     batch_parts = shard_batch((x, y), mesh)
 
     # Host-side snapshot: replicate()'s device_put may alias the source
@@ -161,19 +165,27 @@ def main() -> None:
                  replicate(tx.init(host_vars["params"]), mesh))
         return timed(step, state, batch_parts, batch)
 
-    # The chip may be shared / tunneled, so single measurements drift;
-    # alternate the two paths and keep each one's best.
+    # The chip may be shared / tunneled, so throughput drifts ±2% across
+    # the run. A ratio of each path's best-over-time amplifies that drift
+    # into the comparison; instead pair the two paths back-to-back each
+    # repeat (drift cancels within a pair) and report the MEDIAN pair
+    # ratio, with the best framework throughput as the headline value.
     plain_ips = bench_ips = 0.0
+    ratios = []
     for _ in range(args.repeats):
-        plain_ips = max(plain_ips, run_plain())
-        bench_ips = max(bench_ips, run_bps())
+        p = run_plain()
+        b = run_bps()
+        plain_ips = max(plain_ips, p)
+        bench_ips = max(bench_ips, b)
+        ratios.append(b / n_dev / p)
+    vs = statistics.median(ratios)
 
     print(json.dumps({
         "metric": "resnet50_train_imgs_per_sec_per_chip"
                   if not args.smoke else "resnet18_smoke_imgs_per_sec",
         "value": round(bench_ips / n_dev, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(bench_ips / n_dev / plain_ips, 4),
+        "vs_baseline": round(vs, 4),
     }))
 
 
@@ -231,30 +243,37 @@ def bench_bert(args) -> None:
 
     bps.init()
     mesh = bps.mesh()
-    # The framework step: hierarchical push_pull + donated buffers; in PS
-    # mode this routes the DCN leg through the C++ KV client.
-    bps_step = make_train_step(loss_fn, tx, mesh)
+    # The framework step: hierarchical push_pull; in PS mode this routes
+    # the DCN leg through the C++ KV client. donate=False to match the
+    # non-donating plain baseline (see the resnet path's comment).
+    bps_step = make_train_step(loss_fn, tx, mesh, donate=False)
     batch_parts = shard_batch((toks, mask), mesh)
 
-    # Alternate paths, keep each one's best (shared/tunneled chips drift).
+    # Back-to-back pairs each repeat; median pair ratio (drift cancels
+    # within a pair — see the resnet path's comment).
     plain_ips = bench_ips = 0.0
+    ratios = []
     host_params = jax.tree_util.tree_map(np.asarray, params)
     for _ in range(args.repeats):
-        plain_ips = max(plain_ips, timed(
+        p = timed(
             plain_step,
             (jax.tree_util.tree_map(jnp.array, host_params),
-             tx.init(params)), plain_batch, per_chip))
-        bench_ips = max(bench_ips, timed(
+             tx.init(params)), plain_batch, per_chip)
+        b = timed(
             bps_step, (replicate(host_params, mesh),
                        replicate(tx.init(params), mesh)),
-            batch_parts, batch))
+            batch_parts, batch)
+        plain_ips = max(plain_ips, p)
+        bench_ips = max(bench_ips, b)
+        ratios.append(b / n_dev / p)
+    vs = statistics.median(ratios)
 
     print(json.dumps({
         "metric": "bert_large_mlm_seqs_per_sec_per_chip"
                   if not args.smoke else "bert_smoke_seqs_per_sec",
         "value": round(bench_ips / n_dev, 2),
         "unit": "sequences/sec/chip",
-        "vs_baseline": round(bench_ips / n_dev / plain_ips, 4),
+        "vs_baseline": round(vs, 4),
     }))
 
 

@@ -104,6 +104,12 @@ def tree_all_reduce(
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves:
         return tree
+    # Axis sizes are static at trace time: when neither level has >1
+    # participant the all-reduce is the identity, and the fused
+    # concat/slice round-trip would be pure single-chip HBM tax
+    # (~200 MB of extra reads+writes per step on ResNet-50).
+    if _axis_size(ici_axis) * _axis_size(dcn_axis) == 1:
+        return tree
     if not fuse:
         red = [
             hierarchical_all_reduce(
@@ -263,6 +269,8 @@ def tree_quantized_all_reduce(
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves:
         return tree
+    if _axis_size(ici_axis) * _axis_size(dcn_axis) == 1:
+        return tree  # identity on a 1x1 mesh — skip the quantize round-trip
     sizes = [l.size for l in leaves]
     flat = jnp.concatenate(
         [l.reshape(-1).astype(jnp.float32) for l in leaves])
