@@ -16,70 +16,109 @@ device continues with the remaining backward compute. After the step's
 dispatch completes, the host waits on the per-tensor handles (pulls) and
 applies the optimizer update.
 
+Multi-chip controllers are first-class: the tapped loss runs under
+``shard_map`` over the process-local (dcn, ici) mesh, and each tap's
+backward rule reduce-scatters the gradient over ALL local mesh axes
+inside jit (``lax.psum_scatter`` — the reference's NCCL intra-node
+reduce-scatter stage) before any host transfer. Each chip's callback
+hands the host only its 1/k shard of the locally-summed gradient, so the
+host↔DCN leg carries exactly one gradient's worth of bytes per step
+regardless of local chip count — the reference's two-level pipeline
+(SURVEY.md §3.3) with XLA playing NCCL. Shards are declared as separate
+PS keys (``{name}.{j}``), preserving declaration-order priority
+(front-of-model first) at shard granularity.
+
 Priorities follow parameter declaration order (flattened tree order =
 front-of-model first for standard model pytrees), so early layers' pulls
 complete first — exactly the reference's scheduling rationale.
-
-Topology contract: one JAX process per accelerator (the reference's
-process-per-GPU layout). The local mesh must be a single device; use the
-regular ``make_train_step`` when one controller drives several chips.
 """
 
 from __future__ import annotations
 
 import threading
+from functools import partial
 from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax import lax
 from jax.experimental import io_callback
+from jax.sharding import PartitionSpec as P
 
 import byteps_tpu.jax as bps
+from byteps_tpu.jax._compat import shard_map as _shard_map
 
 
 class _TapState:
-    """Declared tensors + in-flight handles for one step builder."""
+    """Declared shard tensors + in-flight handles for one step builder."""
 
     def __init__(self, client, prefix: str, average: bool,
-                 compression_config: Optional[str]):
+                 compression_config: Optional[str], n_shards: int):
         self.client = client
         self.prefix = prefix
         self.average = average
         self.compression_config = compression_config
-        self.tids: Dict[int, int] = {}
-        self.lock = threading.Lock()
-        self.inflight: Dict[int, Tuple[int, np.ndarray]] = {}
+        self.n_shards = n_shards
+        # (leaf_idx, shard_idx) -> declared tensor id / in-flight handle
+        self.tids: Dict[Tuple[int, int], int] = {}
+        self.shard_elems: Dict[int, int] = {}
+        self.cv = threading.Condition()
+        self.inflight: Dict[Tuple[int, int], Tuple[int, np.ndarray]] = {}
 
     def declare_all(self, leaves) -> None:
+        k = self.n_shards
         for i, leaf in enumerate(leaves):
-            self.tids[i] = self.client.declare(
-                f"{self.prefix}_{i}", int(np.size(leaf)),
-                np.dtype(leaf.dtype).name,
-                compression=self.compression_config)
+            n = int(np.size(leaf))
+            padded = -(-n // k) * k
+            self.shard_elems[i] = padded // k
+            for j in range(k):
+                self.tids[(i, j)] = self.client.declare(
+                    f"{self.prefix}_{i}.{j}", self.shard_elems[i],
+                    np.dtype(leaf.dtype).name,
+                    compression=self.compression_config)
 
-    def push(self, idx: int, g: np.ndarray) -> None:
+    def push_shard(self, idx: int, j, g: np.ndarray) -> None:
         # io_callback may hand a read-only view; the C core sums in place,
         # so stage through a writable copy that also serves as the pull
         # destination.
+        j = int(j)
         arr = np.array(g, copy=True).reshape(-1)
-        h = self.client.push_pull(self.tids[idx], arr,
+        h = self.client.push_pull(self.tids[(idx, j)], arr,
                                   average=self.average)
-        with self.lock:
-            self.inflight[idx] = (h, arr)
+        with self.cv:
+            self.inflight[(idx, j)] = (h, arr)
+            self.cv.notify_all()
 
-    def collect(self, leaves):
+    def _pop(self, key: Tuple[int, int], timeout: float):
+        """Wait until the tap callback for ``key`` has fired, then take
+        its handle. Callbacks are unordered and — on tunneled/remote PJRT
+        platforms — may land after block_until_ready returns, so a plain
+        dict pop would race; waiting on the condition variable makes
+        collect robust no matter when the runtime runs the callback."""
+        with self.cv:
+            if not self.cv.wait_for(lambda: key in self.inflight, timeout):
+                raise RuntimeError(
+                    f"gradient tap {key} never fired within {timeout}s "
+                    "(io_callback lost or step crashed mid-backward)")
+            return self.inflight.pop(key)
+
+    def collect(self, leaves, timeout: float = 120.0):
         out = []
         for i, leaf in enumerate(leaves):
-            with self.lock:
-                h, arr = self.inflight.pop(i)
-            self.client.wait(h)
-            out.append(arr.reshape(leaf.shape).astype(leaf.dtype))
+            shards = []
+            for j in range(self.n_shards):
+                h, arr = self._pop((i, j), timeout)
+                self.client.wait(h)
+                shards.append(arr)
+            flat = shards[0] if self.n_shards == 1 else np.concatenate(shards)
+            out.append(flat[:int(np.size(leaf))].reshape(np.shape(leaf))
+                       .astype(leaf.dtype))
         return out
 
 
-def _make_tap(state: _TapState, idx: int):
+def _make_tap(state: _TapState, idx: int, axes: Tuple[str, ...], k: int):
     @jax.custom_vjp
     def tap(x):
         return x
@@ -88,10 +127,29 @@ def _make_tap(state: _TapState, idx: int):
         return x, None
 
     def bwd(_, g):
-        # Fires mid-backward on the host: enqueue this tensor's push while
-        # the device keeps differentiating earlier layers.
-        io_callback(lambda arr: state.push(idx, arr), None, g,
-                    ordered=False)
+        # Fires mid-backward per device: reduce-scatter this gradient over
+        # the local chips inside jit (ICI collective), then enqueue each
+        # chip's 1/k shard push while the device keeps differentiating
+        # earlier layers. With average=True the local level contributes the
+        # local mean and the PS level averages over workers — the global
+        # mean for a homogeneous fleet (same split as the non-overlapped
+        # PS step in training.py).
+        flat = g.reshape(-1)
+        if k > 1:
+            pad = (-flat.shape[0]) % k
+            if pad:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((pad,), flat.dtype)])
+            shard = lax.psum_scatter(flat, axes, scatter_dimension=0,
+                                     tiled=True)
+            if state.average:
+                shard = shard / k
+            j = lax.axis_index(axes)
+        else:
+            shard = flat
+            j = jnp.int32(0)
+        io_callback(lambda jj, arr: state.push_shard(idx, jj, arr),
+                    None, j, shard, ordered=False)
         return (g,)
 
     tap.defvjp(fwd, bwd)
@@ -109,9 +167,12 @@ def make_overlapped_train_step(
     """Build ``step(params, opt_state, batch) -> (params, opt_state, loss)``
     with hook-style push streaming (see module docstring).
 
-    ``loss_fn(params, batch) -> scalar``. ``compression_config`` is the
+    ``loss_fn(params, batch) -> scalar``. ``batch`` leaves carry this
+    worker's batch on the leading axis; it is sharded over the local mesh
+    axes (single-chip meshes included). ``compression_config`` is the
     C-core codec string (e.g. ``"type=onebit;ef=vanilla"``) applied per
-    tensor on the DCN leg. The returned loss is this worker's local loss.
+    shard tensor on the DCN leg. The returned loss is this worker's local
+    loss (mean over its chips).
     """
     st = bps._st()
     client = st.ps_client
@@ -119,13 +180,11 @@ def make_overlapped_train_step(
         raise RuntimeError(
             "make_overlapped_train_step needs PS mode (init with "
             "DMLC_NUM_SERVER>0 / BYTEPS_PS_MODE=ps)")
-    if st.mesh is not None and st.mesh.size != 1:
-        raise ValueError(
-            "overlapped steps drive one accelerator per process "
-            f"(local mesh has {st.mesh.size} devices); use "
-            "make_train_step for multi-chip controllers")
+    mesh = st.mesh
+    axes = tuple(mesh.axis_names)
+    k = mesh.size
 
-    state = _TapState(client, prefix, average, compression_config)
+    state = _TapState(client, prefix, average, compression_config, k)
     taps: Dict[int, Callable] = {}
 
     def tapped_loss(params, batch):
@@ -133,7 +192,16 @@ def make_overlapped_train_step(
         tapped = [taps[i](leaf) for i, leaf in enumerate(leaves)]
         return loss_fn(jax.tree_util.tree_unflatten(treedef, tapped), batch)
 
-    grad_jit = jax.jit(lambda p, b: jax.value_and_grad(tapped_loss)(p, b)[0])
+    @jax.jit
+    @partial(_shard_map, mesh=mesh, in_specs=(P(), P(axes)),
+             out_specs=P(), check_vma=False)
+    def grad_device(params, batch):
+        # Gradients never leave the program whole: they reach the host
+        # only through the taps' reduce-scattered shards.
+        loss = jax.value_and_grad(tapped_loss)(params, batch)[0]
+        for ax in axes:
+            loss = lax.pmean(loss, ax)
+        return loss
 
     def apply_fn(params, opt_state, grads):
         updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -146,11 +214,13 @@ def make_overlapped_train_step(
         if not taps:
             state.declare_all(leaves)
             for i in range(len(leaves)):
-                taps[i] = _make_tap(state, i)
-        loss = grad_jit(params, batch)
-        # Block for the device (all taps have fired by completion); pushes
-        # already overlapped the backward pass.
+                taps[i] = _make_tap(state, i, axes, k)
+        loss = grad_device(params, batch)
+        # Pushes already overlapped the backward pass; the effects barrier
+        # flushes any unordered callbacks the runtime hasn't yet run, and
+        # collect's cv-wait covers runtimes where even that is lazy.
         loss.block_until_ready()
+        jax.effects_barrier()
         grads = jax.tree_util.tree_unflatten(treedef,
                                              state.collect(leaves))
         params, opt_state = apply_jit(params, opt_state, grads)

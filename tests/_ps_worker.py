@@ -20,6 +20,10 @@ def main() -> int:
         return jax_train_main()
     if mode == "jax_overlap":
         return jax_overlap_main()
+    if mode == "jax_bridge":
+        return jax_bridge_main()
+    if mode == "jax_timeline":
+        return jax_timeline_main()
     if mode == "jax_async":
         return jax_async_main()
     w = Worker.start()
@@ -97,6 +101,62 @@ def main() -> int:
                 np.testing.assert_allclose(arr, 100.0 + rnd)
                 w.barrier(GROUP_WORKERS)
 
+        elif mode == "byte_credit":
+            # Byte-budget admission: huge tensor (16 partitions of 64 KiB)
+            # under a 128 KiB budget -> at most 2 partitions in flight at
+            # any instant; a small tensor declared later still completes.
+            import json
+            n_huge = 16 * 16384  # 16 partitions at BYTEPS_PARTITION_BYTES
+            tid_h = w.declare("huge", n_huge, "float32", compression="")
+            tid_s = w.declare("small", 256, "float32", compression="")
+            big = np.ones(n_huge, dtype=np.float32)
+            small = np.ones(256, dtype=np.float32)
+            h1 = w.push_pull(tid_h, big, average=False)
+            h2 = w.push_pull(tid_s, small, average=False)
+            w.wait(h1)
+            w.wait(h2)
+            np.testing.assert_allclose(big, float(nw))
+            np.testing.assert_allclose(small, float(nw))
+            path = os.path.join(os.environ["BPS_TRACE_OUT"],
+                                f"credit_rank{rank}.json")
+            assert w.dump_trace(path) > 0
+            with open(path) as f:
+                evs = json.load(f)["traceEvents"]
+            pushes = {e["args"]["key"]: e for e in evs if e["name"] == "push"}
+            pulls = {e["args"]["key"]: e for e in evs if e["name"] == "pull"}
+            huge_keys = [k for k in pushes if (k >> 16) == tid_h]
+            assert len(huge_keys) == 16, huge_keys
+            # The measured push-issue..pull-complete span is a sub-window
+            # of the credit window, so measured concurrency can only
+            # under-count — peak > 2 proves the byte cap was violated.
+            marks = []
+            for k in huge_keys:
+                marks.append((pushes[k]["ts"], 1))
+                marks.append((pulls[k]["ts"] + pulls[k]["dur"], -1))
+            cur = peak = 0
+            for _, d in sorted(marks):
+                cur += d
+                peak = max(peak, cur)
+            assert peak <= 2, f"byte credit violated: {peak} in flight"
+
+        elif mode == "deep_pipeline":
+            # 4 rounds of ONE tensor in flight before any wait: rounds
+            # r+2/r+3 map onto slots still serving r/r+1, so the server
+            # must park those pushes (backpressure), not fail-stop. Each
+            # round's aggregate must still be exact.
+            n = 2048
+            tid = w.declare("deep", n, "float32", compression="")
+            base = rng.standard_normal(n).astype(np.float32)
+            arrs = [np.ascontiguousarray(base * (rank + 1) * (i + 1))
+                    for i in range(4)]
+            handles = [w.push_pull(tid, a, average=False) for a in arrs]
+            for h in handles:
+                w.wait(h)
+            scale = sum(r + 1 for r in range(nw))
+            for i, a in enumerate(arrs):
+                np.testing.assert_allclose(
+                    a, base * scale * (i + 1), rtol=1e-4, atol=1e-5)
+
         elif mode == "handles":
             # several in-flight handles; poll semantics
             tids = [w.declare(f"h{i}", 4096, "float32", compression="")
@@ -135,6 +195,38 @@ def main() -> int:
             scale = sum(r + 1 for r in range(nw))
             np.testing.assert_allclose(arr, base * scale, rtol=1e-5,
                                        atol=1e-5)
+
+        elif mode == "pull_compress":
+            # Pull-leg compression: with a codec declared, the server
+            # re-encodes pull responses, so DCN bytes drop in BOTH
+            # directions vs an identical uncompressed tensor.
+            n = 100_000
+            base = rng.standard_normal(n).astype(np.float32)
+            tid_raw = w.declare("pc_raw", n, "float32", compression="")
+            tid_ob = w.declare("pc_ob", n, "float32",
+                               compression="type=onebit")
+            w.barrier(GROUP_WORKERS)
+            s0, r0 = w.net_bytes()
+            arr = base.copy()
+            h = w.push_pull(tid_raw, arr, average=False)
+            w.wait(h)
+            w.barrier(GROUP_WORKERS)
+            s1, r1 = w.net_bytes()
+            arr2 = base.copy()
+            h = w.push_pull(tid_ob, arr2, average=False)
+            w.wait(h)
+            w.barrier(GROUP_WORKERS)
+            s2, r2 = w.net_bytes()
+            raw_sent, raw_recv = s1 - s0, r1 - r0
+            ob_sent, ob_recv = s2 - s1, r2 - r1
+            assert raw_sent > n * 4 and raw_recv > n * 4, (raw_sent, raw_recv)
+            assert ob_sent < raw_sent / 8, (ob_sent, raw_sent)
+            assert ob_recv < raw_recv / 8, (ob_recv, raw_recv)
+            # onebit is idempotent on its own output, so the doubly-
+            # compressed aggregate is still exact for identical pushes.
+            dec = (np.where(base >= 0, 1.0, -1.0).astype(np.float32)
+                   * np.abs(base).mean())
+            np.testing.assert_allclose(arr2, dec * nw, rtol=1e-4, atol=1e-5)
 
         elif mode == "error_feedback":
             # with ef, repeated rounds of a CONSTANT gradient must converge
@@ -312,6 +404,103 @@ def jax_async_main() -> int:
             last = float(loss)
         assert last < first * 0.2, (first, last)
         print(f"worker {rank}: jax_async OK ({first:.4f} -> {last:.4f})")
+        return 0
+    finally:
+        bps_jax.shutdown()
+
+
+def jax_bridge_main() -> int:
+    """Host-boundary discipline of the JAX<->PS bridge: declares are
+    cached for the tree's lifetime (one registration per tensor, not one
+    per step) and repeated steps stay numerically exact. Prints the
+    steady-state bridge step time as a microbenchmark line."""
+    import time
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import byteps_tpu.jax as bps_jax
+    from byteps_tpu.config import get_config
+    from byteps_tpu.jax import ps as ps_mod
+
+    cfg = get_config(reload=True)
+    assert cfg.use_ps
+    bps_jax.init()
+    try:
+        client = bps_jax._st().ps_client
+        nw = client.num_workers()
+        rank = client.worker_rank()
+        # Many small leaves — the shape where per-step declare/ctypes
+        # churn dominated before tid caching.
+        tree = {f"w{i}": jnp.full((257,), float(rank + 1), jnp.float32)
+                for i in range(64)}
+        expect = sum(r + 1 for r in range(nw))
+        t0 = time.perf_counter()
+        steps = 20
+        for _ in range(steps):
+            out = ps_mod.ps_push_pull(tree, average=False, prefix="br")
+        dt = (time.perf_counter() - t0) / steps
+        assert ps_mod.declare_steps == 1, (
+            f"declares must be cached: {ps_mod.declare_steps} declare "
+            "rounds for a fixed tree")
+        for leaf in jax.tree_util.tree_leaves(out):
+            np.testing.assert_allclose(np.asarray(leaf), expect, rtol=1e-6)
+        print(f"worker {rank}: jax_bridge OK "
+              f"({dt * 1e3:.2f} ms/step, 64 leaves x 257 f32)")
+        return 0
+    finally:
+        bps_jax.shutdown()
+
+
+def jax_timeline_main() -> int:
+    """Combined device+DCN timeline from a REAL training step: the
+    Timeline helper captures jax.profiler over the trace window, drains
+    the C core's push/pull spans, and merges both into one Chrome JSON
+    (SURVEY.md §5 XPlane interop)."""
+    import json
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    import byteps_tpu.jax as bps_jax
+    from byteps_tpu.config import get_config
+    from byteps_tpu.jax.training import make_train_step
+    from byteps_tpu.utils import Timeline
+
+    cfg = get_config(reload=True)
+    assert cfg.use_ps and cfg.trace_on
+    bps_jax.init()
+    try:
+        rank = bps_jax._st().ps_client.worker_rank()
+
+        def loss_fn(params, batch):
+            x, y = batch
+            return jnp.mean((x @ params["w"] - y) ** 2)
+
+        tx = optax.sgd(0.05)
+        step = make_train_step(loss_fn, tx)
+        params = {"w": jnp.zeros((64, 8), jnp.float32)}
+        opt_state = tx.init(params)
+        tl = Timeline()
+        prng = np.random.default_rng(3)
+        for _ in range(cfg.trace_end_step + 1):
+            x = jnp.asarray(prng.standard_normal((16, 64)), jnp.float32)
+            y = x[:, :8] * 0.5
+            params, opt_state, loss = step(params, opt_state, (x, y))
+            tl.step()
+        tl.close()
+        combined = os.path.join(cfg.trace_dir, f"combined_rank{rank}.json")
+        assert os.path.exists(combined), "combined timeline not written"
+        with open(combined) as f:
+            evs = json.load(f)["traceEvents"]
+        names = {e.get("name") for e in evs}
+        assert "push" in names and "pull" in names, names
+        dcn = [e for e in evs if e.get("pid") == 900000 and "ts" in e]
+        dev = [e for e in evs if e.get("pid") != 900000 and "ts" in e]
+        assert dcn and dev, (len(dcn), len(dev))
+        print(f"worker {rank}: jax_timeline OK "
+              f"({len(dev)} device events + {len(dcn)} DCN spans merged)")
         return 0
     finally:
         bps_jax.shutdown()
