@@ -68,7 +68,18 @@ void BytePSServer::Process(Message&& msg, int fd) {
           if (!ks->comp_config.empty()) {
             int64_t n = ks->len / static_cast<int64_t>(sizeof(float));
             ks->compressor = CreateCompressor(ks->comp_config, n);
-            if (ks->compressor) ks->scratch.resize(n);
+            if (ks->compressor) {
+              ks->scratch.resize(n);
+              // Reply codec: same algorithm, momentum stripped (see
+              // KeyStore::reply_comp).
+              std::string reply_cfg;
+              for (auto& kvp : ParseCompressorConfig(ks->comp_config)) {
+                if (kvp.first == "momentum" || kvp.first == "mu") continue;
+                if (!reply_cfg.empty()) reply_cfg += ";";
+                reply_cfg += kvp.first + "=" + kvp.second;
+              }
+              ks->reply_comp = CreateCompressor(reply_cfg, n);
+            }
           }
         } else {
           BPS_CHECK_EQ(ks->len, h.arg0) << "key re-declared with new length";
@@ -86,6 +97,20 @@ void BytePSServer::Process(Message&& msg, int fd) {
     case CMD_PUSH: {
       KeyStore* ks = GetStore(h.key);
       BPS_CHECK(ks) << "push for undeclared key " << h.key;
+      const bool is_async = async_ || (h.flags & FLAG_ASYNC);
+      if (!is_async) {
+        // A push for round r+2 can land while its slot still accumulates
+        // or serves round r (3+ rounds of one tensor in flight). Park the
+        // raw message; replayed — and only then acked, which is the
+        // client-side backpressure — once the slot recycles.
+        int slot = h.version & 1;
+        bool busy = ks->ready[slot] ||
+                    (ks->push_count[slot] > 0 && ks->round[slot] != h.version);
+        if (busy) {
+          ks->parked_pushes[slot].emplace_back(std::move(msg), fd);
+          break;
+        }
+      }
       const char* data = msg.payload.data();
       int64_t data_len = static_cast<int64_t>(msg.payload.size());
       // Decompress (compressed pushes are always float32 streams).
@@ -99,7 +124,7 @@ void BytePSServer::Process(Message&& msg, int fd) {
       }
       BPS_CHECK_EQ(data_len, ks->len) << "push length mismatch for " << h.key;
 
-      if (async_ || (h.flags & FLAG_ASYNC)) {
+      if (is_async) {
         // Async: server-resident accumulator; apply now, reply now.
         if (!ks->param_init) {
           ks->param.assign(data, data + data_len);
@@ -109,9 +134,8 @@ void BytePSServer::Process(Message&& msg, int fd) {
         }
       } else {
         int slot = h.version & 1;
-        BPS_CHECK(!ks->ready[slot])
-            << "push into a round still being pulled (key " << h.key << ")";
         if (ks->push_count[slot] == 0) {
+          ks->round[slot] = h.version;
           ks->slot[slot].assign(data, data + data_len);
         } else {
           CpuReducer::Sum(ks->slot[slot].data(), data, data_len, ks->dtype);
@@ -119,11 +143,29 @@ void BytePSServer::Process(Message&& msg, int fd) {
         if (++ks->push_count[slot] == po_->num_workers()) {
           ks->ready[slot] = true;
           ks->pull_count[slot] = 0;
-          // Release any pulls that arrived before the last push.
-          for (auto& p : ks->pending_pulls[slot]) {
-            ReplyPull(ks, slot, p.first, p.second);
+          if (ks->reply_comp) {
+            // Encode once per round; every worker's reply ships the same
+            // compressed aggregate (and EF state advances once).
+            ks->reply_comp->Compress(
+                reinterpret_cast<const float*>(ks->slot[slot].data()),
+                ks->len / static_cast<int64_t>(sizeof(float)),
+                &ks->comp_reply[slot]);
           }
-          ks->pending_pulls[slot].clear();
+          // Release pulls that arrived before the last push — but only
+          // this round's; a later round's pulls stay parked. Move the
+          // list out first: ReplyPull may recycle the slot, and its
+          // replay can append fresh entries.
+          std::vector<std::pair<int, MsgHeader>> waiting;
+          waiting.swap(ks->pending_pulls[slot]);
+          bool recycled = false;
+          for (auto& p : waiting) {
+            if (p.second.version == h.version) {
+              recycled |= ReplyPull(ks, slot, p.first, p.second);
+            } else {
+              ks->pending_pulls[slot].push_back(p);
+            }
+          }
+          if (recycled) ReplayParked(ks, slot);
         }
       }
       MsgHeader ack{};
@@ -149,8 +191,8 @@ void BytePSServer::Process(Message&& msg, int fd) {
         po_->van().Send(fd, resp, ks->param.data(), ks->param.size());
       } else {
         int slot = h.version & 1;
-        if (ks->ready[slot]) {
-          ReplyPull(ks, slot, fd, h);
+        if (ks->ready[slot] && ks->round[slot] == h.version) {
+          if (ReplyPull(ks, slot, fd, h)) ReplayParked(ks, slot);
         } else {
           ks->pending_pulls[slot].emplace_back(fd, h);
         }
@@ -170,6 +212,20 @@ void BytePSServer::Process(Message&& msg, int fd) {
         auto& br = ks->bcast_rounds[round];
         br.data.assign(msg.payload.begin(), msg.payload.end());
         br.served = 0;
+        // Bound stale-round growth: a worker this far behind the root
+        // would already trip heartbeat failure detection, so dropping
+        // the oldest unserved round only trades a hang for a hang —
+        // while keeping server memory bounded.
+        while (ks->bcast_rounds.size() > 16) {
+          auto oldest = ks->bcast_rounds.begin();
+          for (auto it = ks->bcast_rounds.begin();
+               it != ks->bcast_rounds.end(); ++it) {
+            if (it->first < oldest->first) oldest = it;
+          }
+          BPS_LOG(WARNING) << "server: dropping stale bcast round "
+                           << oldest->first << " for key " << h.key;
+          ks->bcast_rounds.erase(oldest);
+        }
       }
       MsgHeader ack{};
       ack.cmd = CMD_PUSH_ACK;
@@ -205,7 +261,7 @@ void BytePSServer::Process(Message&& msg, int fd) {
   }
 }
 
-void BytePSServer::ReplyPull(KeyStore* ks, int slot, int fd,
+bool BytePSServer::ReplyPull(KeyStore* ks, int slot, int fd,
                              const MsgHeader& req) {
   MsgHeader resp{};
   resp.cmd = CMD_PULL_RESP;
@@ -214,12 +270,35 @@ void BytePSServer::ReplyPull(KeyStore* ks, int slot, int fd,
   resp.req_id = req.req_id;
   resp.dtype = ks->dtype;
   resp.version = req.version;
-  po_->van().Send(fd, resp, ks->slot[slot].data(), ks->slot[slot].size());
+  if (ks->reply_comp && !ks->comp_reply[slot].empty()) {
+    resp.flags = FLAG_COMPRESSED;
+    resp.arg0 = ks->len;  // decompressed size, for the worker's check
+    po_->van().Send(fd, resp, ks->comp_reply[slot].data(),
+                    ks->comp_reply[slot].size());
+  } else {
+    po_->van().Send(fd, resp, ks->slot[slot].data(), ks->slot[slot].size());
+  }
   if (++ks->pull_count[slot] == po_->num_workers()) {
     // Round fully served; recycle the slot for round r+2.
     ks->push_count[slot] = 0;
     ks->pull_count[slot] = 0;
     ks->ready[slot] = false;
+    ks->round[slot] = -1;
+    ks->comp_reply[slot].clear();
+    return true;
+  }
+  return false;
+}
+
+void BytePSServer::ReplayParked(KeyStore* ks, int slot) {
+  // Re-run parked pushes through Process: those for the slot's next
+  // round are accepted (and acked); any for a yet-later round re-park
+  // themselves. Move the list out first — Process appends re-parks.
+  auto parked = std::move(ks->parked_pushes[slot]);
+  ks->parked_pushes[slot].clear();
+  for (auto& t : parked) {
+    int pfd = t.second;
+    Process(std::move(t.first), pfd);
   }
 }
 

@@ -43,12 +43,26 @@ class BytePSServer {
     std::string comp_config;
     std::unique_ptr<Compressor> compressor;  // for decompressing pushes
     std::vector<float> scratch;              // decompression target
-    // sync mode: double-buffered rounds
+    // Pull-leg compression (reference §2.2 server symmetry: decompress
+    // pushes, sum, RE-COMPRESS pull responses so the DCN pays compressed
+    // freight in both directions). Separate instance: momentum is a
+    // push-direction decorator and must not be re-applied to aggregates;
+    // error feedback is kept — the server accumulates its own re-encode
+    // residual into the next round (DoubleSqueeze-style two-way EF).
+    std::unique_ptr<Compressor> reply_comp;
+    std::vector<char> comp_reply[2];  // cached encode, one per live round
+    // sync mode: double-buffered rounds. round[s] is the full round
+    // number (head.version) the slot currently accumulates/serves;
+    // pushes/pulls for a LATER round that maps to a busy slot are parked
+    // and replayed when the slot recycles — deep pipelining (3+ rounds
+    // of one tensor in flight) backpressures instead of crashing.
     std::vector<char> slot[2];
     int push_count[2] = {0, 0};
     int pull_count[2] = {0, 0};
     bool ready[2] = {false, false};
+    int round[2] = {-1, -1};
     std::vector<std::pair<int, MsgHeader>> pending_pulls[2];
+    std::vector<std::pair<Message, int>> parked_pushes[2];
     // async mode: server-resident value
     std::vector<char> param;
     bool param_init = false;
@@ -73,7 +87,10 @@ class BytePSServer {
   void EngineLoop(int tid);
   void Process(Message&& msg, int fd);
   KeyStore* GetStore(int64_t key);
-  void ReplyPull(KeyStore* ks, int slot, int fd, const MsgHeader& req);
+  // Returns true when this pull completed the round and recycled the
+  // slot (caller must then ReplayParked).
+  bool ReplyPull(KeyStore* ks, int slot, int fd, const MsgHeader& req);
+  void ReplayParked(KeyStore* ks, int slot);
   void ReplyBcastPull(KeyStore* ks, int fd, const MsgHeader& req);
   void ServeBcastRound(KeyStore* ks, int round, int fd,
                        const MsgHeader& req);

@@ -15,13 +15,27 @@ int64_t NowUs() {
 }
 
 void BytePSWorker::Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
-                         int credit, std::string default_comp, bool trace_on) {
+                         int64_t credit_bytes, std::string default_comp,
+                         bool trace_on) {
   po_ = po;
   kv_ = kv;
   partition_bytes_ = partition_bytes;
   default_comp_ = std::move(default_comp);
   trace_on_ = trace_on;
-  queue_ = std::make_unique<ScheduledQueue>(credit);
+  // Reference semantics: BYTEPS_SCHEDULING_CREDIT is an in-flight BYTE
+  // budget. 0 = auto: four full partitions' worth. A tiny positive value
+  // can only be a legacy partition count — honouring it as bytes would
+  // serialise every push, so floor it loudly (the Python config layer
+  // rejects such values outright).
+  if (credit_bytes > 0 && credit_bytes < 65536) {
+    BPS_LOG(WARNING) << "BYTEPS_SCHEDULING_CREDIT=" << credit_bytes
+                     << " bytes looks like a legacy partition count; "
+                     << "flooring to one partition (" << partition_bytes
+                     << " bytes)";
+    credit_bytes = partition_bytes;
+  }
+  if (credit_bytes <= 0) credit_bytes = 4 * partition_bytes;
+  queue_ = std::make_unique<ScheduledQueue>(credit_bytes);
   push_thread_ = std::thread([this] { PushLoop(); });
 }
 
@@ -123,8 +137,10 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
   TensorCtx* ctx = tensors_[tensor_id].get();
   BPS_CHECK_EQ(ctx->nelem, nelem) << "shape changed for " << ctx->name;
   BPS_CHECK_EQ(ctx->dtype, dtype) << "dtype changed for " << ctx->name;
-  int version = static_cast<int>(ctx->round & 1);
-  ctx->round++;
+  // Full round number on the wire (server: slot = version & 1). Parity
+  // alone cannot tell round r from r+2, which matters once users keep
+  // 3+ push_pull handles of one tensor in flight (deep pipelining).
+  int version = static_cast<int>(ctx->round++);
   int handle_id = next_handle_++;
   auto handle = std::make_shared<Handle>(static_cast<int>(ctx->parts.size()));
   handles_[handle_id] = handle;
@@ -137,6 +153,7 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
     Task task;
     task.priority = ctx->priority;
     task.key = p->key;
+    task.bytes = p->len * esz;  // raw bytes charged against the credit
     task.run = [this, ctx, p, ptr, esz, version, scale, async_mode, handle] {
       char* base = static_cast<char*>(ptr) + p->offset * esz;
       int64_t raw_len = p->len * esz;
@@ -178,14 +195,29 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
                 [this, ctx, p, base, raw_len, scale, handle,
                  t_pull](Message&& resp) {
                   Record(p->key, "pull", t_pull);
-                  BPS_CHECK_EQ(
-                      static_cast<int64_t>(resp.payload.size()), raw_len)
-                      << "pull length mismatch for key " << p->key;
-                  memcpy(base, resp.payload.data(), raw_len);
+                  if (resp.head.flags & FLAG_COMPRESSED) {
+                    // Pull-leg compression: the server re-encoded the
+                    // aggregate with this key's codec (SURVEY.md §2.2
+                    // server symmetry); decode straight into the
+                    // caller's buffer.
+                    BPS_CHECK(p->comp)
+                        << "compressed pull but no codec, key " << p->key;
+                    BPS_CHECK_EQ(resp.head.arg0, raw_len)
+                        << "pull length mismatch for key " << p->key;
+                    p->comp->Decompress(
+                        resp.payload.data(),
+                        static_cast<int64_t>(resp.payload.size()),
+                        reinterpret_cast<float*>(base), p->len);
+                  } else {
+                    BPS_CHECK_EQ(
+                        static_cast<int64_t>(resp.payload.size()), raw_len)
+                        << "pull length mismatch for key " << p->key;
+                    memcpy(base, resp.payload.data(), raw_len);
+                  }
                   if (scale != 1.0) {
                     CpuReducer::Scale(base, scale, raw_len, ctx->dtype);
                   }
-                  queue_->ReleaseCredit();
+                  queue_->ReleaseCredit(raw_len);
                   if (handle->remaining.fetch_sub(1) == 1) {
                     std::lock_guard<std::mutex> lk2(mu_);
                     cv_.notify_all();
