@@ -55,8 +55,9 @@ class Config:
 
     # --- BYTEPS_* family: core tuning --------------------------------------
     partition_bytes: int = 4096000        # BYTEPS_PARTITION_BYTES (~4 MB)
-    scheduling_credit: int = 4            # BYTEPS_SCHEDULING_CREDIT
-    #   credit unit = in-flight partitions admitted to the DCN push stage
+    scheduling_credit: int = 0            # BYTEPS_SCHEDULING_CREDIT
+    #   in-flight BYTE budget for the DCN push stage (reference semantics);
+    #   0 = auto: 4 x partition_bytes
     local_rank: int = 0                   # BYTEPS_LOCAL_RANK
     local_size: int = 1                   # BYTEPS_LOCAL_SIZE
     log_level: str = "WARNING"            # BYTEPS_LOG_LEVEL
@@ -111,8 +112,18 @@ class Config:
                 f"DMLC_ROLE must be one of {VALID_ROLES}, got {self.role!r}")
         if self.partition_bytes <= 0:
             raise ValueError("BYTEPS_PARTITION_BYTES must be positive")
-        if self.scheduling_credit <= 0:
-            raise ValueError("BYTEPS_SCHEDULING_CREDIT must be positive")
+        if self.scheduling_credit < 0:
+            raise ValueError(
+                "BYTEPS_SCHEDULING_CREDIT is a byte budget; must be >= 0 "
+                "(0 = auto: 4 x BYTEPS_PARTITION_BYTES)")
+        if 0 < self.scheduling_credit < 65536:
+            # A handful of BYTES can only be a legacy partition-count
+            # value; silently honouring it would serialise every push.
+            raise ValueError(
+                f"BYTEPS_SCHEDULING_CREDIT={self.scheduling_credit} looks "
+                "like a legacy partition count; it is now an in-flight "
+                "BYTE budget (reference semantics). Set 0 for auto "
+                "(4 x BYTEPS_PARTITION_BYTES) or a value >= 65536.")
         if self.num_worker < 1:
             raise ValueError("DMLC_NUM_WORKER must be >= 1")
         if self.ps_mode not in ("auto", "collective", "ps"):
@@ -130,7 +141,7 @@ def load_config() -> Config:
         root_port=_env_int("DMLC_PS_ROOT_PORT", 9000),
         worker_id=_env_int("DMLC_WORKER_ID", 0),
         partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", 4096000),
-        scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 4),
+        scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
         local_rank=_env_int("BYTEPS_LOCAL_RANK", 0),
         local_size=_env_int("BYTEPS_LOCAL_SIZE", 1),
         log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING").upper(),

@@ -138,7 +138,7 @@ int bps_init(int role) {
     gl->worker = std::make_unique<BytePSWorker>();
     gl->worker->Start(gl->po.get(), gl->kv.get(),
                       EnvInt64("BYTEPS_PARTITION_BYTES", 4096000),
-                      EnvInt("BYTEPS_SCHEDULING_CREDIT", 4),
+                      EnvInt64("BYTEPS_SCHEDULING_CREDIT", 0),
                       DefaultCompConfig(), EnvBool("BYTEPS_TRACE_ON"));
   }
   gl->inited = true;
@@ -203,6 +203,15 @@ int bps_dump_trace(const char* path) {
   fprintf(f, "]}\n");
   fclose(f);
   return static_cast<int>(events.size());
+}
+
+// Cumulative DCN wire bytes through this node's van (frames + payloads).
+// For bandwidth assertions (e.g. both push AND pull legs shrink under
+// compression) and the timeline.
+void bps_net_bytes(long long* sent, long long* recv) {
+  Global* gl = g();
+  *sent = gl->po ? gl->po->van().bytes_sent() : 0;
+  *recv = gl->po ? gl->po->van().bytes_recv() : 0;
 }
 
 // Scheduler-side failure detection: ids of nodes with expired heartbeats.

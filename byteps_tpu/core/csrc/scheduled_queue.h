@@ -4,9 +4,13 @@
 // (BytePSScheduledQueue): partitions are admitted to the DCN push stage
 // highest-priority-first (priority = negative declaration order, so
 // front-of-model gradients go first — the next forward pass needs them
-// first), with a credit cap on in-flight partitions
-// (BYTEPS_SCHEDULING_CREDIT) so one huge tensor cannot monopolise the
-// fabric. addTask/getTask/reportFinish → Push/Pop/ReleaseCredit.
+// first), with a credit cap on in-flight BYTES
+// (BYTEPS_SCHEDULING_CREDIT, the reference's in-flight byte budget) so
+// one huge tensor cannot monopolise the fabric. With mixed partition
+// sizes (the tail slice of every tensor) a partition-count cap would
+// admit wildly different byte volumes; counting bytes keeps the
+// admitted window constant. addTask/getTask/reportFinish →
+// Push/Pop/ReleaseCredit.
 #pragma once
 
 #include <condition_variable>
@@ -22,6 +26,7 @@ struct Task {
   int priority = 0;       // higher = sooner
   int64_t seq = 0;        // FIFO tie-break within a priority level
   int64_t key = 0;
+  int64_t bytes = 0;      // raw partition bytes charged against the budget
   std::function<void()> run;
 };
 
@@ -34,7 +39,7 @@ struct TaskOrder {
 
 class ScheduledQueue {
  public:
-  explicit ScheduledQueue(int credit) : credits_(credit) {}
+  explicit ScheduledQueue(int64_t budget_bytes) : budget_(budget_bytes) {}
 
   void Push(Task t) {
     std::lock_guard<std::mutex> lk(mu_);
@@ -43,23 +48,28 @@ class ScheduledQueue {
     cv_.notify_one();
   }
 
-  // Blocks until a task is available AND a credit is free (or Stop()).
+  // Blocks until the top task fits the byte budget (or Stop()). A task
+  // larger than the whole budget is admitted alone — always-admit-one
+  // keeps oversized partitions live instead of deadlocking.
   bool Pop(Task* out) {
     std::unique_lock<std::mutex> lk(mu_);
     cv_.wait(lk, [this] {
-      return stopped_ || (!heap_.empty() && credits_ > 0);
+      return stopped_ ||
+             (!heap_.empty() &&
+              (inflight_bytes_ == 0 ||
+               inflight_bytes_ + heap_.top().bytes <= budget_));
     });
     if (stopped_) return false;
     *out = heap_.top();
     heap_.pop();
-    credits_--;
+    inflight_bytes_ += out->bytes;
     return true;
   }
 
   // Called when a partition completes its pull (reference: reportFinish).
-  void ReleaseCredit() {
+  void ReleaseCredit(int64_t bytes) {
     std::lock_guard<std::mutex> lk(mu_);
-    credits_++;
+    inflight_bytes_ -= bytes;
     cv_.notify_one();
   }
 
@@ -78,7 +88,8 @@ class ScheduledQueue {
   std::mutex mu_;
   std::condition_variable cv_;
   std::priority_queue<Task, std::vector<Task>, TaskOrder> heap_;
-  int credits_;
+  int64_t budget_;
+  int64_t inflight_bytes_ = 0;
   int64_t seq_ = 0;
   bool stopped_ = false;
 };

@@ -41,7 +41,7 @@ struct TraceEvent {
 class BytePSWorker {
  public:
   void Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
-             int credit, std::string default_comp, bool trace_on);
+             int64_t credit_bytes, std::string default_comp, bool trace_on);
   void Stop();
   ~BytePSWorker() { Stop(); }
 

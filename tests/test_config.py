@@ -11,9 +11,20 @@ def test_defaults(monkeypatch):
     cfg = load_config()
     assert cfg.role == "worker"
     assert cfg.partition_bytes == 4096000
-    assert cfg.scheduling_credit == 4
+    # byte budget; 0 = auto (4 x partition_bytes, resolved in the C core)
+    assert cfg.scheduling_credit == 0
     assert not cfg.distributed
     assert not cfg.use_ps
+
+
+def test_legacy_partition_count_credit_rejected(monkeypatch):
+    """BYTEPS_SCHEDULING_CREDIT is now a byte budget; a tiny value can
+    only be a legacy partition count and must fail loudly instead of
+    silently serialising every push."""
+    monkeypatch.setenv("BYTEPS_SCHEDULING_CREDIT", "4")
+    import pytest
+    with pytest.raises(ValueError, match="byte budget|BYTE budget"):
+        load_config().validate()
 
 
 def test_env_parity_names(monkeypatch):
@@ -23,7 +34,7 @@ def test_env_parity_names(monkeypatch):
     monkeypatch.setenv("DMLC_PS_ROOT_URI", "10.0.0.1")
     monkeypatch.setenv("DMLC_PS_ROOT_PORT", "1234")
     monkeypatch.setenv("BYTEPS_PARTITION_BYTES", "1048576")
-    monkeypatch.setenv("BYTEPS_SCHEDULING_CREDIT", "8")
+    monkeypatch.setenv("BYTEPS_SCHEDULING_CREDIT", "8388608")
     monkeypatch.setenv("BYTEPS_ENABLE_ASYNC", "1")
     monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
     monkeypatch.setenv("BYTEPS_LOG_LEVEL", "debug")
@@ -32,7 +43,7 @@ def test_env_parity_names(monkeypatch):
     assert cfg.num_worker == 4 and cfg.num_server == 2
     assert cfg.root_uri == "10.0.0.1" and cfg.root_port == 1234
     assert cfg.partition_bytes == 1 << 20
-    assert cfg.scheduling_credit == 8
+    assert cfg.scheduling_credit == 8 << 20
     assert cfg.enable_async and cfg.force_distributed and cfg.distributed
     assert cfg.use_ps
     assert cfg.log_level == "DEBUG"
