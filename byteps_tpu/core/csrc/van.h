@@ -44,6 +44,11 @@ class Van {
   void Stop();
   bool stopped() const { return stop_.load(); }
 
+  // Cumulative wire bytes (frames + payloads), for bandwidth assertions
+  // and the timeline. Monotonic over the van's lifetime.
+  int64_t bytes_sent() const { return bytes_sent_.load(); }
+  int64_t bytes_recv() const { return bytes_recv_.load(); }
+
  private:
   void AcceptLoop();
   void RecvLoop(int fd);
@@ -52,6 +57,8 @@ class Van {
   Handler handler_;
   std::atomic<int> listen_fd_{-1};
   std::atomic<bool> stop_{false};
+  std::atomic<int64_t> bytes_sent_{0};
+  std::atomic<int64_t> bytes_recv_{0};
   std::mutex mu_;  // guards send_mu_ / threads_
   // shared_ptr: Send() keeps the per-fd mutex alive across its write even
   // if CloseConn erases the entry concurrently (connection teardown race).

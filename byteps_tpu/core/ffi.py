@@ -73,6 +73,8 @@ def _load() -> ctypes.CDLL:
     lib.bps_poll.restype = ctypes.c_int
     lib.bps_dump_trace.argtypes = [ctypes.c_char_p]
     lib.bps_dump_trace.restype = ctypes.c_int
+    lib.bps_net_bytes.argtypes = [ctypes.POINTER(ctypes.c_longlong),
+                                  ctypes.POINTER(ctypes.c_longlong)]
     lib.bps_dead_nodes.argtypes = [ctypes.POINTER(ctypes.c_int), ctypes.c_int]
     lib.bps_dead_nodes.restype = ctypes.c_int
     _lib = lib
@@ -183,3 +185,11 @@ class Worker(_Node):
 
     def dump_trace(self, path: str) -> int:
         return int(self._lib.bps_dump_trace(path.encode()))
+
+    def net_bytes(self) -> tuple:
+        """Cumulative (sent, received) DCN wire bytes through this
+        worker's van — for bandwidth assertions and the timeline."""
+        s = ctypes.c_longlong()
+        r = ctypes.c_longlong()
+        self._lib.bps_net_bytes(ctypes.byref(s), ctypes.byref(r))
+        return int(s.value), int(r.value)

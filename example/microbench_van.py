@@ -1,0 +1,107 @@
+"""DCN transport goodput microbenchmark (VERDICT r1 #9).
+
+Measures end-to-end push_pull goodput through the full PS stack — C++ van
+(writev gather sends), KV request layer, server engine summation — on a
+localhost scheduler + 1 server + 1 worker topology, at the default 4 MB
+partition size. The number answers: is the TCP van the bottleneck, or the
+fabric?  (Reference context: ps-lite ships an RDMA van because its ZMQ
+path copies; this van's gather-write send path does not.)
+
+Run:  python example/microbench_van.py [--mb 4] [--tensors 16] [--rounds 5]
+Prints one JSON line with goodput in Gbit/s (payload bytes, both legs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def worker_main(args) -> None:
+    import numpy as np
+
+    from byteps_tpu.core import Worker
+
+    w = Worker.start()
+    n = args.mb * (1 << 20) // 4  # f32 elements per tensor
+    tids = [w.declare(f"vb_{i}", n, "float32", compression="")
+            for i in range(args.tensors)]
+    arrs = [np.ones(n, dtype=np.float32) for _ in range(args.tensors)]
+
+    # Warm round (connection setup, first allocations).
+    hs = [w.push_pull(t, a, average=False) for t, a in zip(tids, arrs)]
+    for h in hs:
+        w.wait(h)
+
+    s0, r0 = w.net_bytes()
+    t0 = time.perf_counter()
+    for _ in range(args.rounds):
+        hs = [w.push_pull(t, a, average=False) for t, a in zip(tids, arrs)]
+        for h in hs:
+            w.wait(h)
+    dt = time.perf_counter() - t0
+    s1, r1 = w.net_bytes()
+    payload = args.rounds * args.tensors * n * 4  # one leg, raw bytes
+    print(json.dumps({
+        "metric": "van_pushpull_goodput",
+        "partition_mb": args.mb,
+        "tensors": args.tensors,
+        "rounds": args.rounds,
+        "goodput_gbit_per_s_per_leg": round(payload * 8 / dt / 1e9, 2),
+        "wire_sent_mb": round((s1 - s0) / 1e6, 1),
+        "wire_recv_mb": round((r1 - r0) / 1e6, 1),
+        "seconds": round(dt, 3),
+    }))
+    w.shutdown()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--mb", type=int, default=4, help="partition size (MB)")
+    p.add_argument("--tensors", type=int, default=16)
+    p.add_argument("--rounds", type=int, default=5)
+    p.add_argument("--role", default="")
+    args = p.parse_args()
+    if args.role == "worker":
+        return worker_main(args)
+
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": "1",
+        "DMLC_WORKER_ID": "0",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    procs = []
+    for role in ("scheduler", "server"):
+        e = dict(env)
+        e["DMLC_ROLE"] = role
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "byteps_tpu.server"], env=e))
+    e = dict(env)
+    e["DMLC_ROLE"] = "worker"
+    rc = subprocess.call(
+        [sys.executable, os.path.abspath(__file__), "--role", "worker",
+         "--mb", str(args.mb), "--tensors", str(args.tensors),
+         "--rounds", str(args.rounds)], env=e)
+    for p_ in procs:
+        p_.wait(timeout=30)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
