@@ -88,6 +88,8 @@ def init(mesh: Optional[Mesh] = None, config: Optional[Config] = None) -> None:
                     "BYTEPS_PS_MODE=collective to use pure XLA collectives."
                 ) from e
             ps_client = _ffi.Worker.start(cfg)
+        from byteps_tpu.jax import ps as _ps
+        _ps.reset_declare_cache()
         _state = _State(cfg, mesh, registry, ps_client)
 
 
@@ -97,6 +99,8 @@ def shutdown() -> None:
     with _lock:
         if _state is not None and _state.ps_client is not None:
             _state.ps_client.shutdown()
+        from byteps_tpu.jax import ps as _ps
+        _ps.reset_declare_cache()
         _state = None
 
 
