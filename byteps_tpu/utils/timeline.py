@@ -40,6 +40,8 @@ class Timeline:
         self._step = 0
         self._profiling = False
         self._dumped = False
+        self._device_dir: Optional[str] = None
+        self._anchor_us: Optional[int] = None
         if self._enabled:
             os.makedirs(self._cfg.trace_dir, exist_ok=True)
 
@@ -62,12 +64,23 @@ class Timeline:
             self.close()
 
     def close(self) -> None:
-        """Dump both trace sources (idempotent)."""
+        """Dump both trace sources and the combined timeline (idempotent)."""
         if not self._enabled or self._dumped:
             return
         self._dumped = True
         self._stop_device_trace()
-        self._dump_core_trace()
+        core_path = self._dump_core_trace()
+        # Combined capture (SURVEY.md §5: interop with jax.profiler/XPlane):
+        # device + host stages on ONE timeline.
+        if core_path and self._device_dir and self._anchor_us is not None:
+            try:
+                merge_core_device_traces(
+                    core_path, self._device_dir,
+                    os.path.join(self._cfg.trace_dir,
+                                 f"combined_rank{self._rank()}.json"),
+                    self._anchor_us)
+            except Exception:
+                pass  # the per-source dumps above remain usable
 
     # --- internals ---------------------------------------------------------
 
@@ -80,28 +93,40 @@ class Timeline:
             pass
         return self._cfg.worker_id
 
-    def _dump_core_trace(self) -> None:
-        """Drain the C++ worker's per-partition spans into Chrome JSON."""
+    def _dump_core_trace(self):
+        """Drain the C++ worker's per-partition spans into Chrome JSON.
+        Returns the path, or None when no PS client is live."""
         try:
             import byteps_tpu.jax as bps
             client = bps._st().ps_client if bps.initialized() else None
         except Exception:
             client = None
         if client is None:
-            return
+            return None
         path = os.path.join(self._cfg.trace_dir,
                             f"comm_rank{self._rank()}.json")
         client.dump_trace(path)
+        return path
 
     def _start_device_trace(self) -> None:
         try:
+            import time
+
             import jax
-            jax.profiler.start_trace(
-                os.path.join(self._cfg.trace_dir,
-                             f"device_rank{self._rank()}"))
+            self._device_dir = os.path.join(
+                self._cfg.trace_dir, f"device_rank{self._rank()}")
+            jax.profiler.start_trace(self._device_dir)
+            # Anchor the two clock domains: the C core stamps spans with
+            # CLOCK_MONOTONIC microseconds (std::chrono::steady_clock on
+            # Linux) == time.monotonic_ns()//1000 here. Captured at trace
+            # start so the merge can shift core spans onto the device
+            # trace's timebase.
+            self._anchor_us = time.monotonic_ns() // 1000
             self._profiling = True
         except Exception:
             self._profiling = False
+            self._device_dir = None
+            self._anchor_us = None
 
     def _stop_device_trace(self) -> None:
         if self._profiling:
@@ -110,3 +135,57 @@ class Timeline:
                 jax.profiler.stop_trace()
             finally:
                 self._profiling = False
+
+
+# --- combined device + DCN timeline (SURVEY.md §5 XPlane interop) -----------
+
+_DCN_PID = 900000  # far above real pids; its own process row in the viewer
+
+
+def find_device_chrome_trace(device_dir: str) -> Optional[str]:
+    """Locate the Chrome-trace JSON that ``jax.profiler.stop_trace`` wrote
+    under ``device_dir`` (the TensorBoard trace-viewer file:
+    ``plugins/profile/<run>/<host>.trace.json.gz``)."""
+    import glob
+    paths = glob.glob(os.path.join(device_dir, "plugins", "profile", "*",
+                                   "*.trace.json.gz"))
+    return max(paths, key=os.path.getmtime) if paths else None
+
+
+def merge_core_device_traces(core_path: str, device_dir: str,
+                             out_path: str, anchor_monotonic_us: int) -> int:
+    """Merge the C core's DCN spans into the jax.profiler device trace —
+    one Chrome JSON with device and host-comm stages on a single timeline.
+
+    The core stamps spans in CLOCK_MONOTONIC µs; the device trace uses its
+    own µs timebase starting near ``start_trace``. ``anchor_monotonic_us``
+    (monotonic clock sampled at start_trace) maps one onto the other:
+    device ts 0 ≈ anchor. Returns the number of merged core events.
+    """
+    import gzip
+    import json
+
+    dev_file = find_device_chrome_trace(device_dir)
+    if dev_file is None:
+        raise FileNotFoundError(f"no trace.json.gz under {device_dir}")
+    with gzip.open(dev_file, "rt") as f:
+        dev = json.load(f)
+    with open(core_path) as f:
+        core = json.load(f)
+
+    events = list(dev.get("traceEvents", []))
+    events.append({"name": "process_name", "ph": "M", "pid": _DCN_PID,
+                   "args": {"name": "byteps DCN (C core)"}})
+    n = 0
+    for e in core.get("traceEvents", []):
+        if "ts" not in e:
+            continue
+        shifted = dict(e)
+        shifted["pid"] = _DCN_PID
+        shifted["ts"] = e["ts"] - anchor_monotonic_us
+        events.append(shifted)
+        n += 1
+    dev["traceEvents"] = events
+    with open(out_path, "w") as f:
+        json.dump(dev, f)
+    return n

@@ -123,6 +123,54 @@ def test_timeline_window(tmp_path, monkeypatch):
     tl.close()           # idempotent
 
 
+def test_timeline_combined_device_plus_dcn(tmp_path):
+    """XPlane interop (SURVEY.md §5): the C core's DCN spans merge into
+    the jax.profiler Chrome trace — device and host-comm stages on ONE
+    timeline, core monotonic clock shifted onto the device timebase."""
+    import gzip
+    import json
+    import time
+
+    from byteps_tpu.utils.timeline import (find_device_chrome_trace,
+                                           merge_core_device_traces)
+
+    dev_dir = str(tmp_path / "dev")
+    anchor = time.monotonic_ns() // 1000
+    jax.profiler.start_trace(dev_dir)
+    x = jax.jit(lambda a: a @ a)(jnp.ones((128, 128)))
+    x.block_until_ready()
+    jax.profiler.stop_trace()
+    assert find_device_chrome_trace(dev_dir) is not None
+
+    # Synthetic C-core dump, stamped in the real monotonic clock exactly
+    # as worker.cc::Record does.
+    core_path = str(tmp_path / "comm.json")
+    now = time.monotonic_ns() // 1000
+    core = {"traceEvents": [
+        {"name": "push", "ph": "X", "pid": 0, "tid": 7,
+         "ts": now - 3000, "dur": 1000, "args": {"key": 7}},
+        {"name": "pull", "ph": "X", "pid": 0, "tid": 7,
+         "ts": now - 2000, "dur": 1500, "args": {"key": 7}},
+    ]}
+    with open(core_path, "w") as f:
+        json.dump(core, f)
+
+    out_path = str(tmp_path / "combined.json")
+    n = merge_core_device_traces(core_path, dev_dir, out_path, anchor)
+    assert n == 2
+    with open(out_path) as f:
+        merged = json.load(f)
+    names = [e.get("name") for e in merged["traceEvents"]]
+    assert "push" in names and "pull" in names
+    # device events present too (far more than the 3 core+meta rows)
+    assert len(merged["traceEvents"]) > 10
+    dcn = [e for e in merged["traceEvents"] if e.get("name") == "push"][0]
+    all_ts = [e["ts"] for e in merged["traceEvents"] if "ts" in e]
+    # shifted onto the device timebase: within the trace's ts range,
+    # not at raw monotonic magnitudes
+    assert min(all_ts) - 1e6 < dcn["ts"] < max(all_ts) + 1e6
+
+
 def test_timeline_disabled():
     tl = Timeline(Config(trace_on=False), device_trace=False)
     for _ in range(5):
