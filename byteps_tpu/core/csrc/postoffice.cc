@@ -336,8 +336,12 @@ void Postoffice::Finalize() {
     bool ok = van_->Send(FdOf(kSchedulerId), h);
     BPS_LOG(DEBUG) << "worker " << my_id_ << ": goodbye sent ("
                    << (ok ? "ok" : "FAILED") << "), awaiting fleet SHUTDOWN";
+    // If the goodbye could not be delivered the scheduler is already gone
+    // and no SHUTDOWN reply can ever arrive — don't stall process exit for
+    // the full grace period (other workers may still be training only in
+    // the delivered case).
     std::unique_lock<std::mutex> lk(mu_);
-    cv_.wait_for(lk, std::chrono::seconds(300),
+    cv_.wait_for(lk, std::chrono::seconds(ok ? 300 : 2),
                  [this] { return shutting_down_.load(); });
     lk.unlock();
     van_->Stop();

@@ -86,6 +86,18 @@ def _make_ps_train_step(loss_fn, optimizer, mesh, axes, average, compression,
     """
     from byteps_tpu.jax.ps import ps_push_pull
 
+    if compression.name == "int8_quant":
+        # int8_quant replaces the *collective transport* (all-to-all of
+        # int8 chunks + scales); in PS mode its compress fn is an identity,
+        # so the DCN leg would silently ship uncompressed f32. The PS wire
+        # has its own codec framework — point the user there.
+        raise ValueError(
+            "Compression.int8 only applies to collective mode. In PS mode "
+            "use the C-core codec instead: declare tensors with a "
+            "compressor config string (e.g. BYTEPS_COMPRESSOR=onebit or "
+            "type=dithering;k=4), or use Compression.bf16/fp16 for an "
+            "in-jit wire cast.")
+
     @jax.jit
     @partial(_shard_map, mesh=mesh, in_specs=(P(), P(axes)),
              out_specs=(P(), P()), check_vma=False)

@@ -134,8 +134,12 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
             frac = min(1.0, (epoch + 1.0) / max(1, self.warmup_epochs))
             return 1.0 + frac * (mult - 1.0)
 
+        # Without steps_per_epoch a non-staircase schedule has no per-batch
+        # clock and would silently never adjust the LR — fall back to
+        # per-epoch (staircase) warmup so the ramp still happens.
         super().__init__(initial_lr, warmup_mult, start_epoch=0,
-                         end_epoch=warmup_epochs, staircase=False,
+                         end_epoch=warmup_epochs,
+                         staircase=steps_per_epoch is None,
                          steps_per_epoch=steps_per_epoch)
 
     def on_epoch_end(self, epoch, logs=None):
