@@ -27,6 +27,14 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(items):
+    """Every multi-process topology test is also `slow`; the fast tier is
+    `pytest -m "not slow"` (docs/testing in README)."""
+    for item in items:
+        if "ps" in item.keywords:
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture(autouse=True)
 def _reset_byteps_state():
     """Each test gets a clean global state and a fresh env snapshot."""
