@@ -9,6 +9,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # spawns launcher process trees
+
 from tests.ps_utils import REPO
 
 WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -56,6 +56,7 @@ def test_llama_flash_matches_full():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_llama_remat_matches():
     rng = np.random.default_rng(3)
     toks = _toks(rng, 2, 16)
@@ -92,6 +93,7 @@ def test_llama_dp_training_converges():
     assert losses[-1] < losses[0] * 0.7, losses
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("impl", ["ulysses", "flash"])
 def test_llama_sequence_parallel_matches_full(impl):
     """SP (ulysses, and ulysses+flash inner kernel) matches the
@@ -123,6 +125,7 @@ def test_llama_sequence_parallel_matches_full(impl):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_llama_gqa_ulysses_unrepeated_kv_matches_full():
     """When kv heads divide the sp axis, K/V reshard unrepeated (1/groups
     the all-to-all bytes) and expand after the exchange; numerics match

@@ -13,6 +13,7 @@ from byteps_tpu.models.vgg import VGG16
 from byteps_tpu.parallel.moe import moe_ffn
 
 
+@pytest.mark.slow
 def test_vgg16_forward(rng):
     model = VGG16(num_classes=10, dtype=jnp.float32)
     x = jnp.asarray(rng.standard_normal((2, 32, 32, 3)), jnp.float32)

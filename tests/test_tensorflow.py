@@ -44,6 +44,7 @@ def test_keras_fit_with_callbacks():
     run_topology(2, 1, WORKER, mode="keras_fit", timeout=TF_TIMEOUT)
 
 
+@pytest.mark.slow
 def test_tf_single_process_fallback():
     """No scheduler configured → every collective degrades to a local
     no-op (reference: non-distributed mode)."""

@@ -56,6 +56,7 @@ def test_lm_forward_and_causality(rng):
                            np.asarray(logits2[0, 10:]))
 
 
+@pytest.mark.slow
 def test_mlm_training_reduces_loss(rng):
     model = _tiny_encoder()
     toks = jnp.asarray(rng.integers(0, VOCAB, (4, 16)))
