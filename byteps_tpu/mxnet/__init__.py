@@ -11,6 +11,10 @@ implemented against MXNet's stable NDArray/gluon surface and raises a
 clear ImportError when mxnet is missing rather than failing obscurely.
 The transport underneath is byteps_tpu's C++ PS core, shared with the
 torch/tensorflow plugins.
+
+The plugin logic is still executed by CI: tests/test_ps_core.py runs
+this module over a real localhost PS fleet with only the mxnet package
+itself emulated by the API-faithful stub in tests/mxnet_stub.py.
 """
 
 from __future__ import annotations

@@ -24,6 +24,8 @@ def main() -> int:
         return jax_bridge_main()
     if mode == "jax_timeline":
         return jax_timeline_main()
+    if mode == "mxnet_stub":
+        return mxnet_stub_main()
     if mode == "jax_async":
         return jax_async_main()
     w = Worker.start()
@@ -450,6 +452,63 @@ def jax_bridge_main() -> int:
         return 0
     finally:
         bps_jax.shutdown()
+
+
+def mxnet_stub_main() -> int:
+    """Execute the REAL byteps_tpu.mxnet plugin over the REAL PS topology,
+    with only the (uninstallable, EOL) mxnet package emulated by the
+    API-faithful stub in tests/mxnet_stub.py. Covers push_pull numerics,
+    broadcast_parameters, and DistributedTrainer's reduce+rescale step."""
+    import mxnet_stub
+    sys.modules["mxnet"] = mxnet_stub
+    sys.modules["mxnet.gluon"] = mxnet_stub.gluon
+
+    import byteps_tpu.mxnet as bps_mx
+    from mxnet_stub import NDArray, gluon
+
+    bps_mx.init()
+    try:
+        rank, nw = bps_mx.rank(), bps_mx.size()
+        rng2 = np.random.default_rng(21)
+
+        # push_pull: in-place sum and average across workers
+        base = rng2.standard_normal(48).astype(np.float32)
+        t = NDArray(base * (rank + 1))
+        bps_mx.byteps_push_pull(t, name="mx_t0", is_average=False)
+        scale = sum(r + 1 for r in range(nw))
+        np.testing.assert_allclose(t.asnumpy(), base * scale, rtol=1e-5)
+        t2 = NDArray(np.full(16, float(rank + 1), np.float32))
+        bps_mx.byteps_push_pull(t2, name="mx_t1", is_average=True)
+        np.testing.assert_allclose(t2.asnumpy(), scale / nw, rtol=1e-6)
+
+        # broadcast_parameters from root
+        val = (rng2.standard_normal(10).astype(np.float32)
+               if rank == 0 else np.zeros(10, np.float32))
+        params = {"w": NDArray(val)}
+        bps_mx.broadcast_parameters(params, root_rank=0)
+        # replay rank 0's RNG stream to know what it broadcast
+        root_stream = np.random.default_rng(21)
+        root_stream.standard_normal(48)
+        expect_w = root_stream.standard_normal(10).astype(np.float32)
+        np.testing.assert_allclose(params["w"].asnumpy(), expect_w,
+                                   rtol=1e-6)
+
+        # DistributedTrainer: server-side SUM + _scale/=size == average
+        w0 = np.ones(8, np.float32)
+        p = gluon.Parameter("w", w0.copy())
+        tr = bps_mx.DistributedTrainer(
+            [p], "sgd", {"learning_rate": 0.5})
+        g = np.full(8, float(rank + 1), np.float32)
+        p.set_grad(g)
+        tr.step(batch_size=1)
+        mean_grad = scale / nw
+        np.testing.assert_allclose(
+            p.data().asnumpy(), w0 - 0.5 * mean_grad, rtol=1e-6)
+
+        print(f"worker {rank}: mxnet_stub OK")
+        return 0
+    finally:
+        bps_mx.shutdown()
 
 
 def jax_timeline_main() -> int:

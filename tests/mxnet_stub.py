@@ -1,0 +1,98 @@
+"""API-faithful stub of the MXNet surface byteps_tpu.mxnet touches.
+
+This is NOT MXNet. MXNet is end-of-life and not installable in this
+image, which would leave the plugin as never-executed code. Installing
+this module as ``sys.modules["mxnet"]`` lets the REAL plugin logic
+(declare caching, in-place push_pull/broadcast plumbing, DistributedTrainer
+gradient reduction and LR rescale) execute against the REAL PS topology —
+only the NDArray container and the two gluon classes are emulated, with
+the exact semantics the plugin relies on:
+
+- ``mx.nd.array(arr, dtype=...)`` -> NDArray
+- ``NDArray.asnumpy() / .shape / .dtype / tensor[:] = other``
+- ``gluon.Parameter``: ``.name``, ``.data()``, ``.list_grad()``,
+  ``.grad_req``
+- ``gluon.Trainer``: ``_params``, ``_scale``, ``step()`` calling
+  ``_allreduce_grads()`` then applying ``lr * _scale * grad``
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class NDArray:
+    def __init__(self, data, dtype=None):
+        self._a = np.array(data, dtype=dtype)
+
+    def asnumpy(self):
+        return self._a.copy()
+
+    @property
+    def shape(self):
+        return self._a.shape
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    def __setitem__(self, key, value):
+        self._a[key] = value._a if isinstance(value, NDArray) else value
+
+    def __getitem__(self, key):
+        return NDArray(self._a[key])
+
+
+class _ND:
+    @staticmethod
+    def array(data, dtype=None):
+        return NDArray(data, dtype=dtype)
+
+
+nd = _ND()
+
+
+class _Gluon:
+    class Parameter:
+        def __init__(self, name, value):
+            self.name = name
+            self.grad_req = "write"
+            self._data = NDArray(value)
+            self._grad = NDArray(np.zeros_like(np.asarray(value)))
+
+        def data(self):
+            return self._data
+
+        def list_grad(self):
+            return [self._grad]
+
+        def set_grad(self, value):
+            self._grad = NDArray(np.asarray(value, dtype=self._data.dtype))
+
+    class Trainer:
+        """Minimal gluon.Trainer contract: subclasses override
+        _allreduce_grads; step() reduces then applies
+        ``p -= lr * _scale * grad`` (the plugin divides _scale by
+        worker count so a server-side SUM becomes a true average)."""
+
+        def __init__(self, params, optimizer, optimizer_params=None,
+                     kvstore=None):
+            if hasattr(params, "values"):
+                params = list(params.values())
+            self._params = list(params)
+            self._scale = 1.0
+            self._lr = float((optimizer_params or {}).get(
+                "learning_rate", 0.1))
+
+        def _allreduce_grads(self):
+            pass
+
+        def step(self, batch_size=1):
+            self._allreduce_grads()
+            for p in self._params:
+                if p.grad_req != "null":
+                    p._data._a -= (self._lr * self._scale / batch_size
+                                   * p._grad._a)
+
+
+gluon = _Gluon()

@@ -223,6 +223,14 @@ def test_jax_overlapped_training_with_compression():
                  timeout=180)
 
 
+def test_mxnet_plugin_over_real_topology():
+    """The REAL byteps_tpu.mxnet plugin executes over the REAL PS fleet,
+    with only the uninstallable EOL mxnet package replaced by the
+    API-faithful stub (tests/mxnet_stub.py): push_pull sum/average,
+    broadcast_parameters, DistributedTrainer reduce+rescale."""
+    run_topology(2, 1, WORKER, mode="mxnet_stub")
+
+
 def test_worker_exit_without_shutdown():
     """A worker that never calls shutdown() must still tear down cleanly
     at process exit (C++ Global destructor ordering regression)."""
