@@ -204,10 +204,23 @@ def replicate(tree, mesh: Optional[Mesh] = None):
 
 
 def shard_batch(batch, mesh: Optional[Mesh] = None):
-    """Shard a host batch over the data-parallel mesh axes (leading dim)."""
+    """Shard a host batch over the data-parallel mesh axes (leading dim).
+
+    Single-controller: ``batch`` carries the GLOBAL batch and is laid out
+    over the mesh. Multi-controller (``jax.distributed`` across hosts —
+    the collective-mode analogue of the reference's one-process-per-GPU
+    fleets): ``batch`` carries THIS PROCESS's shard (the Horovod
+    contract — shard your input by ``rank()``), and the shards are
+    assembled into one global array spanning all hosts.
+    """
     mesh = mesh or bps.mesh()
     cfg = bps._st().config
     axes = tuple(a for a in (cfg.dcn_axis, cfg.ici_axis)
                  if a in mesh.axis_names)
     sharding = jax.sharding.NamedSharding(mesh, P(axes))
+    if jax.process_count() > 1:
+        import numpy as np
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)), batch)
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)

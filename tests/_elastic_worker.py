@@ -1,0 +1,107 @@
+"""Preemption-recovery worker: PS-mode training + per-step checkpoints.
+
+First life: worker 0 dies hard (os._exit) right after checkpointing a
+mid-run step — a simulated TPU preemption. The fleet fail-stops (heartbeat
+detection), the launcher's --restarts loop relaunches everything, and the
+second life restores the latest checkpoint and finishes. Final params
+must match an uninterrupted single-process replay — checkpoint/resume
+composed with failure detection and the restart loop (SURVEY.md §5:
+"TPU preemption makes this more important than it was for the
+reference").
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import byteps_tpu.jax as bps  # noqa: E402
+from byteps_tpu.jax.training import make_train_step  # noqa: E402
+from byteps_tpu.utils import restore_checkpoint, save_checkpoint  # noqa: E402
+
+TOTAL_STEPS = 8
+CRASH_AFTER = 4  # preempt after checkpointing this step (first life only)
+PER = 8          # batch rows per worker
+
+
+def make_batch(step: int, rank: int, nw: int):
+    """Deterministic global batch per step; each worker takes its slice."""
+    rng = np.random.default_rng(1000 + step)
+    gx = rng.standard_normal((nw * PER, 6)).astype(np.float32)
+    gy = (gx[:, :3] * 2.0).astype(np.float32)
+    lo, hi = rank * PER, (rank + 1) * PER
+    return (gx, gy), (gx[lo:hi], gy[lo:hi])
+
+
+def loss_fn(params, batch):
+    x, y = batch
+    return jnp.mean((jnp.tanh(x @ params["w1"]) @ params["w2"] - y) ** 2)
+
+
+def init_params():
+    rng = np.random.default_rng(5)
+    return {
+        "w1": jnp.asarray(rng.standard_normal((6, 8)) * 0.4, jnp.float32),
+        "w2": jnp.asarray(rng.standard_normal((8, 3)) * 0.4, jnp.float32),
+    }
+
+
+def main() -> int:
+    base = os.environ["BPS_ELASTIC_DIR"]
+    sentinel = os.path.join(base, "crashed_once")
+    bps.init()
+    client = bps._st().ps_client
+    rank, nw = client.worker_rank(), client.num_workers()
+
+    params0 = init_params()
+    tx = optax.sgd(0.1, momentum=0.9)  # momentum state must survive resume
+    state0 = {"params": params0, "opt": tx.init(params0)}
+    state, done_step = restore_checkpoint(base, state0)
+    start = 0 if done_step is None else done_step + 1
+    if start:
+        print(f"worker {rank}: resumed from checkpoint step {done_step}",
+              flush=True)
+    params, opt_state = state["params"], state["opt"]
+    step = make_train_step(loss_fn, tx)
+
+    for s in range(start, TOTAL_STEPS):
+        _, local = make_batch(s, rank, nw)
+        params, opt_state, _ = step(params, opt_state, local)
+        save_checkpoint(base, {"params": params, "opt": opt_state}, s,
+                        rank=rank)
+        if s == CRASH_AFTER and rank == 0 and not os.path.exists(sentinel):
+            with open(sentinel, "w") as f:
+                f.write("preempted\n")
+            print(f"worker {rank}: simulating preemption after step {s}",
+                  flush=True)
+            os._exit(17)  # hard kill: no shutdown, no goodbye
+
+    # Uninterrupted single-process replay on the full batch.
+    @jax.jit
+    def ref_step(p, st, batch):
+        _, g = jax.value_and_grad(loss_fn)(p, batch)
+        u, st = tx.update(g, st, p)
+        return optax.apply_updates(p, u), st
+
+    ref_p = init_params()
+    ref_s = tx.init(ref_p)
+    for s in range(TOTAL_STEPS):
+        full, _ = make_batch(s, rank, nw)
+        ref_p, ref_s = ref_step(ref_p, ref_s, full)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(params[k]),
+                                   np.asarray(ref_p[k]),
+                                   rtol=3e-4, atol=3e-5)
+    print(f"worker {rank}: elastic OK", flush=True)
+    bps.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,34 @@ pytestmark = pytest.mark.slow  # spawns launcher process trees
 
 from tests.ps_utils import REPO
 
+
+def test_preemption_recovery_with_checkpoint(tmp_path):
+    """Checkpoint/resume composed with failure detection and --restarts:
+    worker 0 os._exit()s mid-run after checkpointing (simulated TPU
+    preemption); heartbeats fail-stop the fleet; the launcher relaunches;
+    the second life resumes from the latest checkpoint and the final
+    params match an uninterrupted single-process replay."""
+    ckpt = tmp_path / "elastic"
+    ckpt.mkdir()
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BPS_ELASTIC_DIR": str(ckpt),
+        "PS_HEARTBEAT_INTERVAL": "1",
+        "PS_HEARTBEAT_TIMEOUT": "4",
+    })
+    worker = os.path.join(REPO, "tests", "_elastic_worker.py")
+    out = subprocess.run(
+        [sys.executable, "-m", "byteps_tpu.launcher", "--local", "2",
+         "--num-servers", "1", "--restarts", "2", "--",
+         sys.executable, worker],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "restart 1/2" in out.stderr, out.stderr
+    assert "simulating preemption" in out.stdout, out.stdout
+    assert "resumed from checkpoint step 4" in out.stdout, out.stdout
+    assert out.stdout.count("elastic OK") == 2, out.stdout
+
 WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "_ps_worker.py")
 

@@ -1,0 +1,53 @@
+"""True multi-controller collective mode: several jax.distributed
+processes, one global mesh, XLA emitting the cross-host collectives —
+the TPU-native counterpart of the reference's multi-machine fleets
+(SURVEY.md §5 "Distributed communication backend": ICI collectives
+intra-host, DCN collectives inter-host, both from one jitted step).
+CPU stand-in: gloo across processes plays DCN, 4 virtual chips per
+process play the slice.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow  # spawns a 2-process jax.distributed fleet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_mc_worker.py")
+
+
+def test_two_controller_collective_training_matches_single_process():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    nproc = 2
+    procs = []
+    for pid in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "MC_COORD": f"127.0.0.1:{port}",
+            "MC_NUM_PROCS": str(nproc),
+            "MC_PROC_ID": str(pid),
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    failed = []
+    try:
+        for pid, p in enumerate(procs):
+            out, _ = p.communicate(timeout=240)
+            if p.returncode != 0:
+                failed.append((pid, p.returncode, out))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    assert not failed, "\n".join(
+        f"--- proc {pid} exited {rc} ---\n{out}" for pid, rc, out in failed)
