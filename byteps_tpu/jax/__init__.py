@@ -201,11 +201,12 @@ def push_pull(tree, average: bool = True, name: Optional[str] = None,
 
 def _per_device_push_pull(tree, average, compression):
     ici, dcn = _axes()
-    if compression.name == "int8_quant":
+    if compression.name in ("int8_quant", "int8_quant_dcn"):
         # quantization replaces the transport itself (all-to-all of int8
         # chunks + scales), not a pre-cast; see hierarchical.py
         return _h.tree_quantized_all_reduce(
-            tree, ici_axis=ici, dcn_axis=dcn, average=average)
+            tree, ici_axis=ici, dcn_axis=dcn, average=average,
+            quantize_dcn=compression.name == "int8_quant_dcn")
     orig_dtypes = jax.tree_util.tree_map(lambda x: x.dtype, tree)
     tree = jax.tree_util.tree_map(compression.compress, tree)
     red = _h.tree_all_reduce(

@@ -46,4 +46,9 @@ class Compression:
     # int8: EQuARX-style blockwise-quantized collective transport (the
     # whole reduce path changes, not just a cast) — push_pull dispatches
     # to parallel.hierarchical.quantized_all_reduce when it sees this.
+    # Plain int8 quantizes the fast (ici) level only; int8_dcn applies
+    # the same scheme to the slow cross-slice fabric too, where the 4x
+    # bandwidth saving matters most in pure collective mode.
     int8 = Compressor("int8_quant", _identity, lambda x, d: x.astype(d))
+    int8_dcn = Compressor("int8_quant_dcn", _identity,
+                          lambda x, d: x.astype(d))

@@ -86,7 +86,7 @@ def _make_ps_train_step(loss_fn, optimizer, mesh, axes, average, compression,
     """
     from byteps_tpu.jax.ps import ps_push_pull
 
-    if compression.name == "int8_quant":
+    if compression.name in ("int8_quant", "int8_quant_dcn"):
         # int8_quant replaces the *collective transport* (all-to-all of
         # int8 chunks + scales); in PS mode its compress fn is an identity,
         # so the DCN leg would silently ship uncompressed f32. The PS wire

@@ -189,6 +189,34 @@ def _blockwise_dequantize(q: jax.Array, scale: jax.Array) -> jax.Array:
     return (q.astype(jnp.float32) * scale).reshape(-1)
 
 
+def _quantized_reduce_scatter(flat: jax.Array, axis: str, block: int
+                              ) -> jax.Array:
+    """int8 reduce-scatter over ``axis``: quantize per destination chunk,
+    all-to-all the int8 chunks + per-block f32 scales, sum dequantized
+    locally. ``flat`` length must divide (axis_size * block). Returns
+    this device's 1/k shard of the sum in f32."""
+    k = _axis_size(axis)
+    chunk = flat.shape[0] // k
+    q, scale = _blockwise_quantize(flat, block)           # [nb, block]
+    q = q.reshape(k, chunk // block, block)
+    scale = scale.reshape(k, chunk // block, 1)
+    q_recv = lax.all_to_all(q, axis, split_axis=0, concat_axis=0,
+                            tiled=False)
+    s_recv = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0,
+                            tiled=False)
+    return jnp.sum(q_recv.astype(jnp.float32) * s_recv, axis=0).reshape(-1)
+
+
+def _quantized_all_gather(shard: jax.Array, axis: str, block: int
+                          ) -> jax.Array:
+    """int8 all-gather over ``axis``: each device ships its quantized
+    shard + scales; everyone dequantizes the concatenation."""
+    q, s = _blockwise_quantize(shard, block)
+    q_all = lax.all_gather(q, axis, axis=0, tiled=True)
+    s_all = lax.all_gather(s, axis, axis=0, tiled=True)
+    return _blockwise_dequantize(q_all, s_all)
+
+
 def quantized_all_reduce(
     x: jax.Array,
     *,
@@ -196,18 +224,24 @@ def quantized_all_reduce(
     dcn_axis: Optional[str] = "dcn",
     average: bool = True,
     block: int = 256,
+    quantize_dcn: bool = False,
 ) -> jax.Array:
-    """Hierarchical all-reduce with int8 blockwise-quantized ICI transport
-    (EQuARX-style, PAPERS.md: arXiv 2506.17615): ~4x the effective ICI
+    """Hierarchical all-reduce with int8 blockwise-quantized transport
+    (EQuARX-style, PAPERS.md: arXiv 2506.17615): ~4x the effective
     bandwidth of f32 (2x bf16) at ~1e-2 relative error per stage.
 
-    Per-device code under shard_map. The reduce-scatter becomes an
-    all-to-all of int8 chunks + per-block f32 scales with a local f32
-    summation, and the return all-gather ships int8 too. The dcn stage
-    stays exact (f32 psum): cross-slice bytes are the PS/codec layer's
-    job (byteps_tpu compression), and double quantization would compound
-    error. Use for bandwidth-bound steps where gradient noise tolerance
-    allows it; pair with error feedback at the optimizer level if needed.
+    Per-device code under shard_map. Each quantized level runs the same
+    scheme: reduce-scatter becomes an all-to-all of int8 chunks +
+    per-block f32 scales with local f32 summation, and the return
+    all-gather ships int8 too.
+
+    ``quantize_dcn=False`` (default) keeps the cross-slice stage exact
+    (f32 psum) — double quantization compounds error, and in PS mode the
+    DCN bytes are the C-core codec layer's job. ``quantize_dcn=True``
+    applies the same int8 scheme to the dcn axis: in pure collective
+    mode the DCN is the *slow* fabric, so that is where the 4x matters
+    most; each shard crosses DCN as int8 both ways. Pair with error
+    feedback at the optimizer level if the noise matters.
     """
     ici = ici_axis if ici_axis and _axis_size(ici_axis) > 1 else None
     dcn = dcn_axis if dcn_axis and _axis_size(dcn_axis) > 1 else None
@@ -217,40 +251,56 @@ def quantized_all_reduce(
     flat = x.reshape(-1)
     n = flat.shape[0]
 
+    if ici is None and dcn is None:
+        return x
     if ici is None:
-        if dcn is not None:
-            flat = lax.psum(flat, dcn)
+        # Single-chip slices: the dcn axis is the only level.
+        if quantize_dcn:
+            kd = _axis_size(dcn)
+            pad = (-n) % (kd * block)
+            if pad:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((pad,), flat.dtype)])
+            shard = _quantized_reduce_scatter(flat, dcn, block)
+            if average:
+                shard = shard / denom
+            out = _quantized_all_gather(shard, dcn, block)
+            if pad:
+                out = out[:n]
+            return out.reshape(orig_shape).astype(orig_dtype)
+        flat = lax.psum(flat, dcn)
         if average and denom > 1:
             flat = flat / denom
         return flat.reshape(orig_shape).astype(orig_dtype)
 
     k = _axis_size(ici)
-    pad = (-n) % (k * block)
+    kd = _axis_size(dcn) if dcn else 1
+    # Pad so the ici shard also tiles (dcn_size * block) when the dcn
+    # level is quantized too.
+    pad = (-n) % (k * kd * block if (dcn and quantize_dcn) else k * block)
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    chunk = flat.shape[0] // k
 
-    # Stage 1: quantize per destination chunk, all-to-all, local f32 sum.
-    q, scale = _blockwise_quantize(flat, block)           # [nb, block]
-    q = q.reshape(k, chunk // block, block)
-    scale = scale.reshape(k, chunk // block, 1)
-    q_recv = lax.all_to_all(q, ici, split_axis=0, concat_axis=0,
-                            tiled=False)
-    s_recv = lax.all_to_all(scale, ici, split_axis=0, concat_axis=0,
-                            tiled=False)
-    shard = jnp.sum(q_recv.astype(jnp.float32) * s_recv, axis=0).reshape(-1)
+    # Stage 1: int8 reduce-scatter over the fast axis.
+    shard = _quantized_reduce_scatter(flat, ici, block)
 
-    # Stage 2: exact cross-slice reduction.
+    # Stage 2: cross-slice reduction — exact psum, or the same int8
+    # scheme when the slow fabric's bytes dominate.
     if dcn is not None:
-        shard = lax.psum(shard, dcn)
-    if average and denom > 1:
+        if quantize_dcn:
+            dshard = _quantized_reduce_scatter(shard, dcn, block)
+            if average:
+                dshard = dshard / denom
+            shard = _quantized_all_gather(dshard, dcn, block)
+        else:
+            shard = lax.psum(shard, dcn)
+            if average:
+                shard = shard / denom
+    elif average and denom > 1:
         shard = shard / denom
 
-    # Stage 3: quantize the owned shard, all-gather, dequantize.
-    q2, s2 = _blockwise_quantize(shard, block)
-    q_all = lax.all_gather(q2, ici, axis=0, tiled=True)
-    s_all = lax.all_gather(s2, ici, axis=0, tiled=True)
-    out = _blockwise_dequantize(q_all, s_all)
+    # Stage 3: int8 all-gather back over the fast axis.
+    out = _quantized_all_gather(shard, ici, block)
     if pad:
         out = out[:n]
     return out.reshape(orig_shape).astype(orig_dtype)
@@ -263,6 +313,7 @@ def tree_quantized_all_reduce(
     dcn_axis: Optional[str] = "dcn",
     average: bool = True,
     block: int = 256,
+    quantize_dcn: bool = False,
 ):
     """Fused pytree variant of quantized_all_reduce: one flat f32 buffer,
     one quantized collective pair (tensor fusion, as tree_all_reduce)."""
@@ -275,7 +326,8 @@ def tree_quantized_all_reduce(
     flat = jnp.concatenate(
         [l.reshape(-1).astype(jnp.float32) for l in leaves])
     flat = quantized_all_reduce(flat, ici_axis=ici_axis, dcn_axis=dcn_axis,
-                                average=average, block=block)
+                                average=average, block=block,
+                                quantize_dcn=quantize_dcn)
     out, off = [], 0
     for leaf, sz in zip(leaves, sizes):
         out.append(flat[off:off + sz].reshape(leaf.shape)

@@ -13,7 +13,10 @@ import sys
 pid = int(os.environ["MC_PROC_ID"])
 nproc = int(os.environ["MC_NUM_PROCS"])
 
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if not f.startswith("--xla_force_host_platform_device_count")]
+_flags.append("--xla_force_host_platform_device_count=4")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 

@@ -8,23 +8,20 @@ process play the slice.
 """
 
 import os
-import socket
 import subprocess
 import sys
 
 import pytest
 
+from tests.ps_utils import REPO, free_port
+
 pytestmark = pytest.mark.slow  # spawns a 2-process jax.distributed fleet
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "_mc_worker.py")
 
 
 def test_two_controller_collective_training_matches_single_process():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+    port = free_port()
     nproc = 2
     procs = []
     for pid in range(nproc):

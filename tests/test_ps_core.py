@@ -213,6 +213,19 @@ def test_jax_overlapped_training_multichip_controller():
                  timeout=240)
 
 
+def test_jax_overlap_stress_4workers_2servers_compressed_multichip():
+    """Composition stress: 4 worker processes x 2 virtual chips each,
+    2 servers, per-layer overlap (reduce-scattered taps), C-core codec
+    with error feedback, and the pull-leg re-encode — all at once."""
+    run_topology(4, 2, WORKER, mode="jax_overlap",
+                 extra={"BYTEPS_PS_MODE": "ps",
+                        "XLA_FLAGS":
+                            "--xla_force_host_platform_device_count=2",
+                        "BPS_OVERLAP_COMPRESSION":
+                            "type=topk;k=48;ef=vanilla"},
+                 timeout=300)
+
+
 def test_jax_overlapped_training_with_compression():
     """Per-layer overlap composed with the C-core codec layer (topk + error
     feedback on the streamed pushes)."""

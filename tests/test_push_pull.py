@@ -173,3 +173,32 @@ def test_push_pull_int8_quantized_wire():
     expect = _np.mean(_np.asarray(g), axis=0)
     _np.testing.assert_allclose(_np.asarray(out), expect, rtol=0.05,
                                 atol=0.05)
+
+
+def test_push_pull_int8_dcn_quantized_both_levels():
+    """Compression.int8_dcn quantizes the slow cross-slice leg too (the
+    same all-to-all + local-sum scheme per level); error stays within the
+    compounded two-level quantization tolerance of the exact mean."""
+    import numpy as _np
+
+    from byteps_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(dcn=2, ici=4))
+    bps.init(mesh=mesh)
+    rng = _np.random.default_rng(4)
+    g = jnp.asarray(rng.standard_normal((8, 4096)), jnp.float32)
+    out = bps.push_pull({"g": g}, average=True,
+                        compression=bps.Compression.int8_dcn)["g"]
+    expect = _np.mean(_np.asarray(g), axis=0)
+    err = _np.abs(_np.asarray(out) - expect)
+    scale = _np.abs(_np.asarray(g)).max()
+    assert err.max() <= 0.08 * scale, err.max() / scale
+    # and the dcn-only degenerate mesh (single-chip slices) works too
+    bps.shutdown()
+    mesh2 = build_mesh(MeshSpec(dcn=8, ici=1))
+    bps.init(mesh=mesh2)
+    out2 = bps.push_pull({"g": g}, average=False,
+                         compression=bps.Compression.int8_dcn)["g"]
+    expect2 = _np.sum(_np.asarray(g), axis=0)
+    err2 = _np.abs(_np.asarray(out2) - expect2)
+    assert err2.max() <= 0.08 * _np.abs(expect2).max() + 0.5, err2.max()
