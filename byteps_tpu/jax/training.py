@@ -92,11 +92,11 @@ def _make_ps_train_step(loss_fn, optimizer, mesh, axes, average, compression,
         # so the DCN leg would silently ship uncompressed f32. The PS wire
         # has its own codec framework — point the user there.
         raise ValueError(
-            "Compression.int8 only applies to collective mode. In PS mode "
-            "use the C-core codec instead: declare tensors with a "
-            "compressor config string (e.g. BYTEPS_COMPRESSOR=onebit or "
-            "type=dithering;k=4), or use Compression.bf16/fp16 for an "
-            "in-jit wire cast.")
+            f"Compression {compression.name!r} (int8 quantized transport) "
+            "only applies to collective mode. In PS mode use the C-core "
+            "codec instead: declare tensors with a compressor config "
+            "string (e.g. BYTEPS_COMPRESSOR=onebit or type=dithering;k=4), "
+            "or use Compression.bf16/fp16 for an in-jit wire cast.")
 
     @jax.jit
     @partial(_shard_map, mesh=mesh, in_specs=(P(), P(axes)),

@@ -24,4 +24,5 @@ from byteps_tpu.models.transformer import (  # noqa: F401
     TransformerLM,
     lm_loss,
     masked_lm_loss,
+    sp_lm_loss,
 )

@@ -206,3 +206,32 @@ def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     tgt = tokens[:, 1:]
     ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
     return -ll.mean()
+
+
+def sp_lm_loss(logits: jax.Array, tokens: jax.Array, axis: str) -> jax.Array:
+    """``lm_loss`` for sequence-sharded chunks (per-device code under
+    shard_map, sequence split over ``axis``).
+
+    Plain ``lm_loss`` per chunk silently drops every chunk-boundary
+    prediction (each chunk loses its last position), so chunked and
+    full-sequence losses diverge. Here each device's last position is
+    scored against the NEXT chunk's first token (one ppermute around the
+    sp ring); only the globally-last position goes unscored, and the
+    value is scaled so ``pmean`` over ``axis`` (and over any
+    disjoint-batch DP axes) equals the full-sequence ``lm_loss`` exactly.
+    """
+    k = jax.lax.axis_size(axis)
+    if k == 1:
+        return lm_loss(logits, tokens)
+    idx = jax.lax.axis_index(axis)
+    nxt_first = jax.lax.ppermute(
+        tokens[:, 0], axis, [(i, (i - 1) % k) for i in range(k)])
+    tgt = jnp.concatenate([tokens[:, 1:], nxt_first[:, None]], axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    # The last device's final position has no successor token.
+    scored = jnp.ones_like(ll).at[:, -1].set(
+        jnp.where(idx == k - 1, 0.0, 1.0))
+    b, s_local = ll.shape
+    total = b * (k * s_local - 1)  # positions scored across the ring
+    return -jnp.sum(ll * scored) * k / total

@@ -193,8 +193,8 @@ def _quantized_reduce_scatter(flat: jax.Array, axis: str, block: int
                               ) -> jax.Array:
     """int8 reduce-scatter over ``axis``: quantize per destination chunk,
     all-to-all the int8 chunks + per-block f32 scales, sum dequantized
-    locally. ``flat`` length must divide (axis_size * block). Returns
-    this device's 1/k shard of the sum in f32."""
+    locally. ``flat`` length must be divisible by (axis_size * block).
+    Returns this device's 1/k shard of the sum in f32."""
     k = _axis_size(axis)
     chunk = flat.shape[0] // k
     q, scale = _blockwise_quantize(flat, block)           # [nb, block]
@@ -254,24 +254,16 @@ def quantized_all_reduce(
     if ici is None and dcn is None:
         return x
     if ici is None:
-        # Single-chip slices: the dcn axis is the only level.
+        # Single-chip slices: the dcn axis is the only level. With
+        # quantize_dcn it becomes the (sole) quantized level — fall
+        # through to the generic stages with dcn playing ici's role.
         if quantize_dcn:
-            kd = _axis_size(dcn)
-            pad = (-n) % (kd * block)
-            if pad:
-                flat = jnp.concatenate(
-                    [flat, jnp.zeros((pad,), flat.dtype)])
-            shard = _quantized_reduce_scatter(flat, dcn, block)
-            if average:
-                shard = shard / denom
-            out = _quantized_all_gather(shard, dcn, block)
-            if pad:
-                out = out[:n]
-            return out.reshape(orig_shape).astype(orig_dtype)
-        flat = lax.psum(flat, dcn)
-        if average and denom > 1:
-            flat = flat / denom
-        return flat.reshape(orig_shape).astype(orig_dtype)
+            ici, dcn = dcn, None
+        else:
+            flat = lax.psum(flat, dcn)
+            if average and denom > 1:
+                flat = flat / denom
+            return flat.reshape(orig_shape).astype(orig_dtype)
 
     k = _axis_size(ici)
     kd = _axis_size(dcn) if dcn else 1

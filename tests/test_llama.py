@@ -94,6 +94,71 @@ def test_llama_dp_training_converges():
 
 
 @pytest.mark.slow
+def test_llama_dp_x_sp_training_matches_single_device():
+    """2-D long-context composition: sequence parallelism over the fast
+    ``ici`` axis (ring attention rides the intra-slice fabric) x data
+    parallelism over ``dcn``, with the ordinary hierarchical push_pull
+    reducing gradients over BOTH axes. Training numerics must match a
+    single-device run on the same full batch."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from byteps_tpu.jax._compat import shard_map as _shard_map
+
+    mesh = build_mesh(MeshSpec(dcn=2, ici=4))  # 2 DP slices x 4-way SP
+    bps.init(mesh=mesh)
+    rng = np.random.default_rng(6)
+    model = LlamaTiny(dtype=jnp.float32, attn_impl="ring", sp_axis="ici")
+    ref_model = LlamaTiny(dtype=jnp.float32)
+    toks0 = _toks(rng, 4, 32)  # batch 4 over dcn=2, seq 32 over ici=4
+    params0 = ref_model.init(jax.random.PRNGKey(0), toks0)
+    tx = optax.sgd(0.2)
+
+    from byteps_tpu.models.transformer import sp_lm_loss
+
+    @jax.jit
+    @partial(_shard_map, mesh=mesh, in_specs=(P(), P(), P("dcn", "ici")),
+             out_specs=(P(), P(), P()), check_vma=False)
+    def step(p, opt_state, batch):
+        # sp_lm_loss scores chunk-boundary predictions via the sp ring
+        # and scales so that pmean over both axes == the full-batch
+        # lm_loss; push_pull's average then gives exactly the full-batch
+        # gradient.
+        loss, grads = jax.value_and_grad(
+            lambda p_: sp_lm_loss(model.apply(p_, batch), batch,
+                                  "ici"))(p)
+        grads = bps.push_pull(grads, average=True)
+        updates, opt_state = tx.update(grads, opt_state, p)
+        p = optax.apply_updates(p, updates)
+        for ax in ("dcn", "ici"):
+            loss = jax.lax.pmean(loss, ax)
+        return p, opt_state, loss
+
+    @jax.jit
+    def ref_step(p, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p_: lm_loss(ref_model.apply(p_, batch), batch))(p)
+        updates, opt_state = tx.update(grads, opt_state, p)
+        return optax.apply_updates(p, updates), opt_state, loss
+
+    p = jax.tree_util.tree_map(jnp.array, params0)
+    o = tx.init(params0)
+    rp = jax.tree_util.tree_map(jnp.array, params0)
+    ro = tx.init(params0)
+    for s in range(4):
+        toks = _toks(rng, 4, 32)
+        p, o, loss = step(p, o, toks)
+        rp, ro, ref_loss = ref_step(rp, ro, toks)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=2e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(p),
+                    jax.tree_util.tree_leaves(rp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-3, atol=3e-4)
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("impl", ["ulysses", "flash"])
 def test_llama_sequence_parallel_matches_full(impl):
     """SP (ulysses, and ulysses+flash inner kernel) matches the

@@ -170,3 +170,27 @@ def test_quantized_all_reduce_zero_and_constant(rng):
     out = np.asarray(run(g))
     np.testing.assert_allclose(out[0][:64], np.zeros(64), atol=1e-6)
     np.testing.assert_allclose(out[0][64:], np.full(64, 24.0), rtol=0.02)
+
+
+def test_sp_lm_loss_matches_full_sequence(rng):
+    """sp_lm_loss on sequence chunks pmean's to EXACTLY the full-sequence
+    lm_loss: chunk-boundary predictions are scored via the sp ring, only
+    the globally-last position is unscored."""
+    from functools import partial
+
+    from byteps_tpu.models.transformer import lm_loss, sp_lm_loss
+
+    k = 4
+    mesh = Mesh(np.asarray(jax.devices()[:k]), ("sp",))
+    b, s, v = 2, 32, 17
+    logits = jnp.asarray(rng.standard_normal((b, s, v)), jnp.float32)
+    tokens = jnp.asarray(rng.integers(0, v, (b, s)), jnp.int32)
+
+    @partial(_shard_map, mesh=mesh, in_specs=(P(None, "sp"), P(None, "sp")),
+             out_specs=P(), check_vma=False)
+    def chunked(lg, tk):
+        return jax.lax.pmean(sp_lm_loss(lg, tk, "sp"), "sp")
+
+    full = float(lm_loss(logits, tokens))
+    got = float(chunked(logits, tokens))
+    np.testing.assert_allclose(got, full, rtol=1e-6)
