@@ -13,6 +13,20 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EX = os.path.join(REPO, "example")
 
 
+# The container's sitecustomize force-registers the TPU platform
+# programmatically, which beats the JAX_PLATFORMS env var — examples must
+# be exec'd through a shim that pins the config the way conftest does, or
+# they silently run single-chip on the real TPU instead of the 8-device
+# virtual CPU mesh.
+_CPU_SHIM = (
+    "import os, runpy, sys; import jax; "
+    "os.environ.get('JAX_PLATFORMS', '').lower() == 'cpu' and "
+    "jax.config.update('jax_platforms', 'cpu'); "
+    "sys.argv = sys.argv[1:]; "
+    "runpy.run_path(sys.argv[0], run_name='__main__')"
+)
+
+
 def _run(script, *cli, extra_env=None, timeout=420):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -21,7 +35,8 @@ def _run(script, *cli, extra_env=None, timeout=420):
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                             + " --xla_force_host_platform_device_count=8")
     env.update(extra_env or {})
-    out = subprocess.run([sys.executable, script, *cli], env=env,
+    out = subprocess.run([sys.executable, "-c", _CPU_SHIM, script, *cli],
+                         env=env,
                          capture_output=True, text=True, timeout=timeout)
     assert out.returncode == 0, out.stdout + out.stderr
     return out.stdout
@@ -139,3 +154,12 @@ def test_llama_long_context_example():
                "--d-model", "64", "--heads", "4", "--kv-heads", "2",
                "--vocab", "512", "--fp32")
     assert "tokens/sec" in out
+
+
+def test_llama_long_context_example_sequence_parallel():
+    """--sp: ring attention over the 8-device ici axis + SP-aware loss."""
+    out = _run(os.path.join(EX, "jax", "train_llama_long_context.py"),
+               "--seq-len", "256", "--steps", "2", "--layers", "2",
+               "--d-model", "64", "--heads", "4", "--kv-heads", "2",
+               "--vocab", "512", "--fp32", "--sp")
+    assert "sp=8xring" in out, out
