@@ -4,12 +4,15 @@
 // entry points (byteps_init / byteps_declare_tensor / EnqueueTensor /
 // byteps_rank / ...; SURVEY.md §2.1) — env-var configured exactly like the
 // reference (DMLC_* / BYTEPS_* families, docs/ENV.md).
+#include <chrono>
 #include <cstdio>
 #include <cstring>
 #include <memory>
 #include <string>
+#include <vector>
 
 #include "common.h"
+#include "cpu_reducer.h"
 #include "debug.h"
 #include "kv.h"
 #include "logging.h"
@@ -203,6 +206,24 @@ int bps_dump_trace(const char* path) {
   fprintf(f, "]}\n");
   fclose(f);
   return static_cast<int>(events.size());
+}
+
+// Standalone CpuReducer throughput probe: repeatedly sum a src buffer
+// into dst (the server's hot loop) and return GB/s of summed INPUT
+// bytes. Callable without any topology (SURVEY.md §7 hard part #5:
+// server summation must not be the bottleneck — measure it).
+double bps_reducer_bench(long long nbytes, int iters, int dtype) {
+  if (nbytes <= 0 || iters <= 0 || DtypeSize(dtype) == 0) return -1.0;
+  std::vector<char> dst(nbytes, 1), src(nbytes, 2);
+  CpuReducer::Sum(dst.data(), src.data(), nbytes, dtype);  // warm
+  auto t0 = std::chrono::steady_clock::now();
+  for (int i = 0; i < iters; ++i) {
+    CpuReducer::Sum(dst.data(), src.data(), nbytes, dtype);
+  }
+  double s = std::chrono::duration<double>(
+                 std::chrono::steady_clock::now() - t0)
+                 .count();
+  return static_cast<double>(nbytes) * iters / s / 1e9;
 }
 
 // Cumulative DCN wire bytes through this node's van (frames + payloads).

@@ -9,12 +9,35 @@
 #include <unistd.h>
 
 #include <cerrno>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 
 #include "logging.h"
 
 namespace bps {
+
+// ps-lite parity: PS_VERBOSE=2 logs every message on the wire (1 is
+// reserved for connection-level events, matching the reference's split).
+static int VerboseLevel() {
+  static const int v = [] {
+    const char* e = getenv("PS_VERBOSE");
+    return e ? atoi(e) : 0;
+  }();
+  return v;
+}
+
+static void LogMsg(const char* dir, int fd, const MsgHeader& h,
+                   int64_t payload_len) {
+  if (VerboseLevel() >= 2) {
+    // Direct stderr: PS_VERBOSE must work standalone, independent of the
+    // BYTEPS_LOG_LEVEL gate (ps-lite behaves the same way).
+    fprintf(stderr, "[PS_VERBOSE] van %s fd=%d cmd=%d key=%lld ver=%d "
+            "req=%d len=%lld\n", dir, fd, h.cmd,
+            static_cast<long long>(h.key), h.version, h.req_id,
+            static_cast<long long>(payload_len));
+  }
+}
 
 // Size data-connection socket buffers for high-bandwidth-delay links
 // (DCN between TPU pods and PS racks): the kernel default (~200 KB) caps
@@ -130,6 +153,9 @@ bool Van::Send(int fd, const MsgHeader& head, const void* payload,
     smu = it->second;
   }
   std::lock_guard<std::mutex> lk(*smu);
+  // Under the per-fd send lock so the PS_VERBOSE trace order matches the
+  // actual wire order (the whole point of a message trace).
+  LogMsg("send", fd, h, payload_len);
   iovec iov[3];
   iov[0].iov_base = &total;
   iov[0].iov_len = sizeof(total);
@@ -208,6 +234,7 @@ void Van::RecvLoop(int fd) {
     }
     bytes_recv_.fetch_add(static_cast<int64_t>(sizeof(total) + total),
                           std::memory_order_relaxed);
+    LogMsg("recv", fd, msg.head, static_cast<int64_t>(plen));
     handler_(std::move(msg), fd);
   }
   CloseConn(fd);

@@ -75,10 +75,27 @@ def _load() -> ctypes.CDLL:
     lib.bps_dump_trace.restype = ctypes.c_int
     lib.bps_net_bytes.argtypes = [ctypes.POINTER(ctypes.c_longlong),
                                   ctypes.POINTER(ctypes.c_longlong)]
+    lib.bps_reducer_bench.argtypes = [ctypes.c_longlong, ctypes.c_int,
+                                      ctypes.c_int]
+    lib.bps_reducer_bench.restype = ctypes.c_double
     lib.bps_dead_nodes.argtypes = [ctypes.POINTER(ctypes.c_int), ctypes.c_int]
     lib.bps_dead_nodes.restype = ctypes.c_int
     _lib = lib
     return lib
+
+
+def reducer_bench(nbytes: int = 64 << 20, iters: int = 20,
+                  dtype: str = "float32") -> float:
+    """GB/s of the CPU summation hot loop (no topology needed): the
+    server-side bottleneck check from SURVEY.md §7 — aggregate server
+    summation bandwidth must exceed aggregate worker NIC bandwidth."""
+    lib = _load()
+    gbps = float(lib.bps_reducer_bench(
+        nbytes, iters, _DTYPE_MAP[np.dtype(dtype).name]))
+    if gbps < 0:
+        raise ValueError(f"bad reducer_bench args: nbytes={nbytes} "
+                         f"iters={iters} dtype={dtype}")
+    return gbps
 
 
 def _apply_config_env(cfg: Optional[Config]) -> None:

@@ -38,7 +38,8 @@ def main() -> int:
             # sum over workers, several shapes/dtypes, repeated rounds
             for rnd in range(3):
                 for shape, dtype in [((64,), "float32"), ((31, 7), "float32"),
-                                     ((128,), "float64"), ((16,), "int32")]:
+                                     ((128,), "float64"), ((16,), "int32"),
+                                     ((257,), "float16")]:
                     base = rng.standard_normal(shape)
                     x0 = (base * (rank + 1 + rnd)).astype(dtype)
                     expect = sum(
@@ -50,9 +51,11 @@ def main() -> int:
                     arr = np.ascontiguousarray(x0)
                     h = w.push_pull(tid, arr, average=False)
                     w.wait(h)
+                    # fp16: each pairwise add rounds to half precision
+                    rtol = 2e-3 if dtype == "float16" else 1e-5
                     np.testing.assert_allclose(
                         arr.astype("float64"), expect.reshape(shape),
-                        rtol=1e-5, atol=1e-8)
+                        rtol=rtol, atol=1e-8)
 
         elif mode == "average":
             tid = w.declare("avg", 50, "float32", compression="")
