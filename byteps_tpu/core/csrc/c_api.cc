@@ -214,7 +214,11 @@ int bps_dump_trace(const char* path) {
 // server summation must not be the bottleneck — measure it).
 double bps_reducer_bench(long long nbytes, int iters, int dtype) {
   if (nbytes <= 0 || iters <= 0 || DtypeSize(dtype) == 0) return -1.0;
-  std::vector<char> dst(nbytes, 1), src(nbytes, 2);
+  // 0x3C byte fill: normal-range values in every float format (fp16
+  // 0x3C3C ~= 1.06, f32 0x3C3C3C3C ~= 0.011) — a 0x01 fill would make
+  // fp16 lanes subnormal and measure the worst-case conversion branch
+  // instead of typical gradient values.
+  std::vector<char> dst(nbytes, 0x3C), src(nbytes, 0x3D);
   CpuReducer::Sum(dst.data(), src.data(), nbytes, dtype);  // warm
   auto t0 = std::chrono::steady_clock::now();
   for (int i = 0; i < iters; ++i) {
