@@ -35,6 +35,7 @@ __all__ = [
     "init", "shutdown", "initialized", "rank", "size", "local_rank",
     "local_size", "push_pull", "broadcast", "broadcast_variables",
     "DistributedOptimizer", "DistributedGradientTape", "Compression",
+    "BroadcastGlobalVariablesHook",
 ]
 
 _lock = threading.Lock()
@@ -344,3 +345,32 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
     wrapped = _Wrapped.__new__(_Wrapped)
     wrapped.__dict__.update(optimizer.__dict__)
     return wrapped
+
+
+def BroadcastGlobalVariablesHook(root_rank: int = 0):
+    """TF1-compat session hook (reference: byteps.tensorflow
+    BroadcastGlobalVariablesHook): broadcasts all global variables from
+    ``root_rank`` right after session creation, so graph-mode
+    ``tf.compat.v1`` training starts from identical weights. The
+    broadcast ops are built in ``begin()`` (before graph finalisation)
+    and run once in ``after_create_session``.
+    """
+    _require_init()
+
+    class _Hook(tf.compat.v1.train.SessionRunHook):
+        def __init__(self):
+            self._bcast_op = None
+
+        def begin(self):
+            vs = tf.compat.v1.global_variables()
+            self._bcast_op = tf.group(*[
+                tf.compat.v1.assign(
+                    v, broadcast(v, root_rank=root_rank,
+                                 name=f"bcast.hook.{i}.{v.name}"))
+                for i, v in enumerate(vs)
+            ])
+
+        def after_create_session(self, session, coord):
+            session.run(self._bcast_op)
+
+    return _Hook()

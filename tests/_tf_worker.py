@@ -76,6 +76,21 @@ def main() -> int:
             np.testing.assert_allclose(v.numpy(), ref_v.numpy())
             np.testing.assert_allclose(w.numpy(), ref_w.numpy())
 
+        elif mode == "v1_hook":
+            # TF1 graph mode: BroadcastGlobalVariablesHook syncs globals
+            # from root right after session creation.
+            tf.compat.v1.disable_eager_execution()
+            g = tf.Graph()
+            with g.as_default():
+                init_val = np.full((6,), float(rank + 10), np.float32)
+                v = tf.compat.v1.get_variable(
+                    "v", initializer=tf.constant(init_val))
+                hook = bps.BroadcastGlobalVariablesHook(root_rank=0)
+                with tf.compat.v1.train.MonitoredSession(
+                        hooks=[hook]) as sess:
+                    got = sess.run(v)
+            np.testing.assert_allclose(got, np.full((6,), 10.0))
+
         elif mode == "tape_train":
             # DistributedGradientTape custom loop reproduces single-process
             # numerics: every rank sees the same average gradient.

@@ -40,6 +40,13 @@ def test_tf_distributed_optimizer():
 
 
 @ps
+def test_tf1_broadcast_hook():
+    """TF1-compat BroadcastGlobalVariablesHook (reference API): graph-mode
+    MonitoredSession starts with root's weights on every worker."""
+    run_topology(2, 1, WORKER, mode="v1_hook", timeout=TF_TIMEOUT)
+
+
+@ps
 def test_keras_fit_with_callbacks():
     run_topology(2, 1, WORKER, mode="keras_fit", timeout=TF_TIMEOUT)
 
@@ -103,3 +110,29 @@ def test_mxnet_plugin_gated():
     else:
         with pytest.raises(ImportError, match="byteps_tpu.jax"):
             import byteps_tpu.mxnet  # noqa: F401
+
+
+@pytest.mark.slow
+def test_keras_warmup_falls_back_to_staircase_without_steps():
+    """ADVICE r1: LearningRateWarmupCallback(steps_per_epoch=None) used
+    to be a silent no-op (non-staircase schedule with no per-batch
+    clock). It must fall back to per-epoch staircase warmup."""
+    tf = pytest.importorskip("tensorflow")
+    import byteps_tpu.keras as bps_keras  # noqa: F401  (registers plugin)
+    from byteps_tpu.keras.callbacks import LearningRateWarmupCallback
+
+    opt = tf.keras.optimizers.SGD(learning_rate=0.1)
+    cb = LearningRateWarmupCallback(initial_lr=0.1, multiplier=4.0,
+                                    warmup_epochs=4)
+
+    class _M:
+        optimizer = opt
+
+    cb.set_model(_M())
+    lrs = []
+    for e in range(4):
+        cb.on_epoch_begin(e)
+        cb.on_batch_begin(0)
+        lrs.append(float(tf.keras.backend.get_value(opt.learning_rate)))
+    assert lrs[-1] > lrs[0] > 0.1, lrs   # the ramp actually happened
+    assert abs(lrs[-1] - 0.4) < 1e-6, lrs  # fully warmed: 0.1 * 4
