@@ -55,36 +55,64 @@ class _TapState:
     """Declared shard tensors + in-flight handles for one step builder."""
 
     def __init__(self, client, prefix: str, average: bool,
-                 compression_config: Optional[str], n_shards: int):
+                 compression_config: Optional[str], n_shards: int,
+                 wire_dtype: str = "float32", wire_block: int = 256):
         self.client = client
         self.prefix = prefix
         self.average = average
         self.compression_config = compression_config
         self.n_shards = n_shards
+        self.wire_dtype = wire_dtype
+        self.wire_block = wire_block
         # (leaf_idx, shard_idx) -> declared tensor id / in-flight handle
         self.tids: Dict[Tuple[int, int], int] = {}
         self.shard_elems: Dict[int, int] = {}
+        self.blocks: Dict[int, int] = {}
         self.cv = threading.Condition()
         self.inflight: Dict[Tuple[int, int], Tuple[int, np.ndarray]] = {}
+
+    def pad_unit(self, idx: int) -> int:
+        """Leaf ``idx``'s flat gradient is padded to this multiple before
+        scattering (int8 wire additionally needs block-tiled shards).
+        The quantization block shrinks with the leaf so a 3-element bias
+        is not padded out to k*256 elements of PS traffic."""
+        return self.n_shards * self.blocks[idx]
 
     def declare_all(self, leaves) -> None:
         k = self.n_shards
         for i, leaf in enumerate(leaves):
             n = int(np.size(leaf))
-            padded = -(-n // k) * k
+            if self.wire_dtype == "int8":
+                self.blocks[i] = min(self.wire_block, max(1, -(-n // k)))
+            else:
+                self.blocks[i] = 1
+            unit = self.pad_unit(i)
+            padded = -(-n // unit) * unit
             self.shard_elems[i] = padded // k
+            # Quantized/cast wires always land as f32 on the host (the C
+            # codecs and summation operate on f32).
+            dt = (np.dtype(leaf.dtype).name
+                  if self.wire_dtype == "float32" else "float32")
             for j in range(k):
                 self.tids[(i, j)] = self.client.declare(
-                    f"{self.prefix}_{i}.{j}", self.shard_elems[i],
-                    np.dtype(leaf.dtype).name,
+                    f"{self.prefix}_{i}.{j}", self.shard_elems[i], dt,
                     compression=self.compression_config)
 
-    def push_shard(self, idx: int, j, g: np.ndarray) -> None:
+    def push_shard(self, idx: int, j, g: np.ndarray,
+                   scales: Optional[np.ndarray] = None) -> None:
         # io_callback may hand a read-only view; the C core sums in place,
         # so stage through a writable copy that also serves as the pull
         # destination.
         j = int(j)
-        arr = np.array(g, copy=True).reshape(-1)
+        if scales is not None:
+            # int8 wire: dequantize blockwise on the host (cheap
+            # vectorised numpy), push f32.
+            arr = (np.asarray(g, np.float32).reshape(-1, self.blocks[idx])
+                   * np.asarray(scales, np.float32).reshape(-1, 1)
+                   ).reshape(-1)
+        else:
+            arr = np.array(g, dtype=np.float32 if self.wire_dtype != "float32"
+                           else None, copy=True).reshape(-1)
         h = self.client.push_pull(self.tids[(idx, j)], arr,
                                   average=self.average)
         with self.cv:
@@ -135,11 +163,10 @@ def _make_tap(state: _TapState, idx: int, axes: Tuple[str, ...], k: int):
         # mean for a homogeneous fleet (same split as the non-overlapped
         # PS step in training.py).
         flat = g.reshape(-1)
+        pad = (-flat.shape[0]) % state.pad_unit(idx)
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
         if k > 1:
-            pad = (-flat.shape[0]) % k
-            if pad:
-                flat = jnp.concatenate(
-                    [flat, jnp.zeros((pad,), flat.dtype)])
             shard = lax.psum_scatter(flat, axes, scatter_dimension=0,
                                      tiled=True)
             if state.average:
@@ -148,8 +175,25 @@ def _make_tap(state: _TapState, idx: int, axes: Tuple[str, ...], k: int):
         else:
             shard = flat
             j = jnp.int32(0)
-        io_callback(lambda jj, arr: state.push_shard(idx, jj, arr),
-                    None, j, shard, ordered=False)
+        # On-device wire compression (SURVEY.md §7 step 5): the D2H
+        # transfer is the host boundary's scarce resource on real chips —
+        # cast (bf16, 2x) or blockwise-quantize (int8 + per-block scales,
+        # ~4x) INSIDE jit so fewer bytes cross it. The host re-expands to
+        # f32 before the PS push; DCN-leg compression stays the C codec's
+        # job. The quantization loss here is per-step (not error-fed).
+        if state.wire_dtype == "int8":
+            from byteps_tpu.parallel.hierarchical import _blockwise_quantize
+            q, scales = _blockwise_quantize(shard, state.blocks[idx])
+            io_callback(
+                lambda jj, qq, ss: state.push_shard(idx, jj, qq, ss),
+                None, j, q, scales, ordered=False)
+        elif state.wire_dtype == "bfloat16":
+            io_callback(lambda jj, arr: state.push_shard(idx, jj, arr),
+                        None, j, shard.astype(jnp.bfloat16),
+                        ordered=False)
+        else:
+            io_callback(lambda jj, arr: state.push_shard(idx, jj, arr),
+                        None, j, shard, ordered=False)
         return (g,)
 
     tap.defvjp(fwd, bwd)
@@ -162,6 +206,8 @@ def make_overlapped_train_step(
     *,
     average: bool = True,
     compression_config: Optional[str] = None,
+    wire_dtype: str = "float32",
+    wire_block: int = 256,
     prefix: str = "ograd",
 ):
     """Build ``step(params, opt_state, batch) -> (params, opt_state, loss)``
@@ -171,8 +217,13 @@ def make_overlapped_train_step(
     worker's batch on the leading axis; it is sharded over the local mesh
     axes (single-chip meshes included). ``compression_config`` is the
     C-core codec string (e.g. ``"type=onebit;ef=vanilla"``) applied per
-    shard tensor on the DCN leg. The returned loss is this worker's local
-    loss (mean over its chips).
+    shard tensor on the DCN leg. ``wire_dtype`` compresses the
+    device->host transfer inside jit: ``"bfloat16"`` (2x, ~1e-3 error)
+    or ``"int8"`` (blockwise-quantized, ~4x, ~1e-2 error, not
+    error-fed); the host re-expands to f32 before the PS push.
+    ``wire_block`` caps the int8 scale-block size (it shrinks
+    automatically for small leaves so padding stays proportional). The
+    returned loss is this worker's local loss (mean over its chips).
     """
     st = bps._st()
     client = st.ps_client
@@ -180,11 +231,15 @@ def make_overlapped_train_step(
         raise RuntimeError(
             "make_overlapped_train_step needs PS mode (init with "
             "DMLC_NUM_SERVER>0 / BYTEPS_PS_MODE=ps)")
+    if wire_dtype not in ("float32", "bfloat16", "int8"):
+        raise ValueError(
+            f"wire_dtype must be float32|bfloat16|int8, got {wire_dtype!r}")
     mesh = st.mesh
     axes = tuple(mesh.axis_names)
     k = mesh.size
 
-    state = _TapState(client, prefix, average, compression_config, k)
+    state = _TapState(client, prefix, average, compression_config, k,
+                      wire_dtype=wire_dtype, wire_block=wire_block)
     taps: Dict[int, Callable] = {}
 
     def tapped_loss(params, batch):

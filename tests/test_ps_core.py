@@ -213,6 +213,25 @@ def test_jax_overlapped_training_multichip_controller():
                  timeout=240)
 
 
+def test_jax_overlap_device_wire_compression():
+    """On-device wire compression for the host boundary (SURVEY.md §7
+    step 5): taps cast/quantize the reduce-scattered shard INSIDE jit —
+    bf16 (2x) stays near-exact; int8 (4x) converges within quantization
+    tolerance — on multi-chip controllers."""
+    run_topology(2, 1, WORKER, mode="jax_overlap",
+                 extra={"BYTEPS_PS_MODE": "ps",
+                        "XLA_FLAGS":
+                            "--xla_force_host_platform_device_count=4",
+                        "BPS_OVERLAP_WIRE": "bfloat16"},
+                 timeout=240)
+    run_topology(2, 1, WORKER, mode="jax_overlap",
+                 extra={"BYTEPS_PS_MODE": "ps",
+                        "XLA_FLAGS":
+                            "--xla_force_host_platform_device_count=4",
+                        "BPS_OVERLAP_WIRE": "int8"},
+                 timeout=240)
+
+
 def test_jax_overlap_stress_4workers_2servers_compressed_multichip():
     """Composition stress: 4 worker processes x 2 virtual chips each,
     2 servers, per-layer overlap (reduce-scattered taps), C-core codec
