@@ -144,6 +144,60 @@ def main() -> int:
                 peak = max(peak, cur)
             assert peak <= 2, f"byte credit violated: {peak} in flight"
 
+        elif mode == "priority":
+            # The reference's scheduling rationale: an EARLIER-declared
+            # (front-of-model) tensor preempts a later-declared one at
+            # the queue even when enqueued second. Per round: a "plug"
+            # soaks up the 1-partition byte budget, then LATE is enqueued
+            # before EARLY. In a round where both enqueues beat the
+            # plug's round trip, a priority scheduler pops ALL of early
+            # first — min(early push ts) < min(late push ts) — a
+            # signature FIFO (or inverted priority) can NEVER produce,
+            # since late entered the queue first. On a loaded 1-core box
+            # a round can degenerate (late drains before early is even
+            # enqueued), so assert the signature appears in >= 1 of 12
+            # rounds (empirically most rounds are non-degenerate).
+            import json
+            n = 4 * 16384  # 4 partitions at BYTEPS_PARTITION_BYTES=65536
+            rounds = 12
+            plug = np.ones(16384, dtype=np.float32)
+            a = np.ones(n, dtype=np.float32)
+            b = np.ones(n, dtype=np.float32)
+            tids = []
+            for rnd in range(rounds):
+                tid_plug = w.declare(f"plug{rnd}", 16384, "float32",
+                                     compression="")
+                tid_early = w.declare(f"early{rnd}", n, "float32",
+                                      compression="")
+                tid_late = w.declare(f"late{rnd}", n, "float32",
+                                     compression="")
+                tids.append((tid_early, tid_late))
+                h_plug = w.push_pull(tid_plug, plug, average=False)
+                h_late = w.push_pull(tid_late, b, average=False)
+                h_early = w.push_pull(tid_early, a, average=False)
+                w.wait(h_plug)
+                w.wait(h_late)
+                w.wait(h_early)
+            path = os.path.join(os.environ["BPS_TRACE_OUT"],
+                                f"prio_rank{rank}.json")
+            assert w.dump_trace(path) > 0
+            with open(path) as f:
+                evs = json.load(f)["traceEvents"]
+            pushes = [e for e in evs if e["name"] == "push"]
+            signal = 0
+            for tid_early, tid_late in tids:
+                early_ts = [e["ts"] for e in pushes
+                            if (e["args"]["key"] >> 16) == tid_early]
+                late_ts = [e["ts"] for e in pushes
+                           if (e["args"]["key"] >> 16) == tid_late]
+                assert len(early_ts) == 4 and len(late_ts) == 4
+                if min(early_ts) < min(late_ts):
+                    signal += 1
+            assert signal >= 1, (
+                f"no priority preemption observed in {rounds} rounds: "
+                "the earlier-declared tensor never popped ahead of the "
+                "later-declared one enqueued before it")
+
         elif mode == "deep_pipeline":
             # 4 rounds of ONE tensor in flight before any wait: rounds
             # r+2/r+3 map onto slots still serving r/r+1, so the server

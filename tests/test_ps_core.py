@@ -61,6 +61,19 @@ def test_byte_credit_bounds_inflight(tmp_path):
                         "BPS_TRACE_OUT": str(tmp_path)})
 
 
+def test_priority_preemption(tmp_path):
+    """Declaration-order priority (the reference's front-of-model-first
+    scheduling): across repeated rounds under a 1-partition byte budget,
+    the earlier-declared tensor pops ahead of a later-declared tensor
+    that entered the queue first — a pop order FIFO cannot produce."""
+    run_topology(1, 1, WORKER, mode="priority",
+                 extra={"BYTEPS_PARTITION_BYTES": "65536",
+                        "BYTEPS_SCHEDULING_CREDIT": "65536",
+                        "BYTEPS_FORCE_DISTRIBUTED": "1",
+                        "BYTEPS_TRACE_ON": "1",
+                        "BPS_TRACE_OUT": str(tmp_path)})
+
+
 def test_deep_pipelining_one_tensor():
     """3+ rounds of one tensor in flight: the server must park (not
     fail-stop on) pushes for a round whose slot is still busy, and every
