@@ -307,6 +307,39 @@ def test_topology_clean_under_asan():
         "ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
     }
     run_topology(2, 1, WORKER, mode="basic", extra=extra, timeout=120)
+    # Round-2 concurrency paths: parked pushes + replay (deep
+    # pipelining), the cached compressed reply + both-ways codec path,
+    # and the byte-credit admission window.
+    run_topology(2, 1, WORKER, mode="deep_pipeline", extra=extra,
+                 timeout=120)
+    run_topology(2, 1, WORKER, mode="pull_compress", extra=extra,
+                 timeout=180)
     nsd = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "_no_shutdown_worker.py")
     run_topology(2, 1, nsd, extra=extra, timeout=120)
+
+
+@pytest.mark.ps
+def test_topology_clean_under_tsan():
+    """Data-race check on the van/engine/queue threading, including the
+    round-2 parked-push replay path (ThreadSanitizer build; OpenMP is
+    disabled in it — TSan and OpenMP runtimes don't compose)."""
+    import subprocess
+
+    from byteps_tpu.core.build import build
+
+    gxx = os.environ.get("CXX", "g++")
+    libtsan = subprocess.run(
+        [gxx, "-print-file-name=libtsan.so"],
+        capture_output=True, text=True).stdout.strip()
+    if not libtsan or not os.path.isabs(libtsan):
+        pytest.skip("libtsan not available")
+    lib = build(sanitize="thread", verbose=False)
+    extra = {
+        "BPS_CORE_LIB": lib,
+        "LD_PRELOAD": libtsan,
+        "TSAN_OPTIONS": "halt_on_error=1:report_bugs=1",
+    }
+    run_topology(2, 1, WORKER, mode="basic", extra=extra, timeout=240)
+    run_topology(2, 1, WORKER, mode="deep_pipeline", extra=extra,
+                 timeout=240)
