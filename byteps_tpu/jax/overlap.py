@@ -56,7 +56,8 @@ class _TapState:
 
     def __init__(self, client, prefix: str, average: bool,
                  compression_config: Optional[str], n_shards: int,
-                 wire_dtype: str = "float32", wire_block: int = 256):
+                 wire_dtype: str = "float32", wire_block: int = 256,
+                 backward_passes_per_step: int = 1):
         self.client = client
         self.prefix = prefix
         self.average = average
@@ -64,6 +65,9 @@ class _TapState:
         self.n_shards = n_shards
         self.wire_dtype = wire_dtype
         self.wire_block = wire_block
+        self.bpps = backward_passes_per_step
+        self.acc: Dict[Tuple[int, int], np.ndarray] = {}
+        self.acc_count: Dict[Tuple[int, int], int] = {}
         # (leaf_idx, shard_idx) -> declared tensor id / in-flight handle
         self.tids: Dict[Tuple[int, int], int] = {}
         self.shard_elems: Dict[int, int] = {}
@@ -113,6 +117,24 @@ class _TapState:
         else:
             arr = np.array(g, dtype=np.float32 if self.wire_dtype != "float32"
                            else None, copy=True).reshape(-1)
+        if self.bpps > 1:
+            # Gradient accumulation (reference: DistributedOptimizer
+            # backward_passes_per_step): sum K backward passes host-side,
+            # communicate once on the K-th. Division by K is the
+            # caller's, exactly as in the reference. Under the lock:
+            # unordered io_callbacks for the same key can run on
+            # different host threads (a straggler from microbatch m
+            # racing m+1), and an unguarded read-modify-write here would
+            # lose a gradient or an acc_count increment.
+            key = (idx, j)
+            with self.cv:
+                acc = self.acc.get(key)
+                self.acc[key] = arr if acc is None else acc + arr
+                self.acc_count[key] = self.acc_count.get(key, 0) + 1
+                if self.acc_count[key] < self.bpps:
+                    return
+                arr = self.acc.pop(key)
+                self.acc_count[key] = 0
         h = self.client.push_pull(self.tids[(idx, j)], arr,
                                   average=self.average)
         with self.cv:
@@ -208,6 +230,7 @@ def make_overlapped_train_step(
     compression_config: Optional[str] = None,
     wire_dtype: str = "float32",
     wire_block: int = 256,
+    backward_passes_per_step: int = 1,
     prefix: str = "ograd",
 ):
     """Build ``step(params, opt_state, batch) -> (params, opt_state, loss)``
@@ -222,7 +245,11 @@ def make_overlapped_train_step(
     or ``"int8"`` (blockwise-quantized, ~4x, ~1e-2 error, not
     error-fed); the host re-expands to f32 before the PS push.
     ``wire_block`` caps the int8 scale-block size (it shrinks
-    automatically for small leaves so padding stays proportional). The
+    automatically for small leaves so padding stays proportional).
+    ``backward_passes_per_step=K`` accumulates K backward passes
+    host-side and communicates once on the K-th (the reference's
+    gradient-accumulation contract; divide by K in your optimizer) —
+    non-final calls return the params/opt_state unchanged. The
     returned loss is this worker's local loss (mean over its chips).
     """
     st = bps._st()
@@ -234,12 +261,15 @@ def make_overlapped_train_step(
     if wire_dtype not in ("float32", "bfloat16", "int8"):
         raise ValueError(
             f"wire_dtype must be float32|bfloat16|int8, got {wire_dtype!r}")
+    if backward_passes_per_step < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
     mesh = st.mesh
     axes = tuple(mesh.axis_names)
     k = mesh.size
 
     state = _TapState(client, prefix, average, compression_config, k,
-                      wire_dtype=wire_dtype, wire_block=wire_block)
+                      wire_dtype=wire_dtype, wire_block=wire_block,
+                      backward_passes_per_step=backward_passes_per_step)
     taps: Dict[int, Callable] = {}
 
     def tapped_loss(params, batch):
@@ -264,6 +294,8 @@ def make_overlapped_train_step(
 
     apply_jit = jax.jit(apply_fn)
 
+    micro = [0]
+
     def step(params, opt_state, batch):
         leaves, treedef = jax.tree_util.tree_flatten(params)
         if not taps:
@@ -276,6 +308,11 @@ def make_overlapped_train_step(
         # collect's cv-wait covers runtimes where even that is lazy.
         loss.block_until_ready()
         jax.effects_barrier()
+        micro[0] += 1
+        if micro[0] % backward_passes_per_step:
+            # accumulation pass: gradients summed host-side, nothing on
+            # the wire yet, parameters unchanged
+            return params, opt_state, loss
         grads = jax.tree_util.tree_unflatten(treedef,
                                              state.collect(leaves))
         params, opt_state = apply_jit(params, opt_state, grads)

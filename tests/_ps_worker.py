@@ -26,6 +26,8 @@ def main() -> int:
         return jax_timeline_main()
     if mode == "mxnet_stub":
         return mxnet_stub_main()
+    if mode == "jax_overlap_accum":
+        return jax_overlap_accum_main()
     if mode == "jax_async":
         return jax_async_main()
     w = Worker.start()
@@ -642,6 +644,67 @@ def jax_overlap_main() -> int:
     finally:
         # always tear down the C++ worker threads, or a failing assert
         # leaves this process (and the whole fleet) hanging
+        bps_jax.shutdown()
+
+
+def jax_overlap_accum_main() -> int:
+    """backward_passes_per_step in the overlap path: K accumulation
+    passes push once and must equal one big-batch step exactly (lr
+    scaled by 1/K — the caller-divides contract)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    import byteps_tpu.jax as bps_jax
+    from byteps_tpu.jax.overlap import make_overlapped_train_step
+
+    bps_jax.init()
+    try:
+        st = bps_jax._st()
+        rank = st.ps_client.worker_rank()
+        nw = st.ps_client.num_workers()
+        K = 3
+
+        def loss_fn(params, batch):
+            x, y = batch
+            return jnp.mean((jnp.tanh(x @ params["w"]) - y) ** 2)
+
+        prng = np.random.default_rng(8)
+        params0 = {"w": jnp.asarray(prng.standard_normal((5, 4)),
+                                    jnp.float32) * 0.4}
+        lr = 0.3
+        tx = optax.sgd(lr / K)  # caller divides by K
+        step = make_overlapped_train_step(loss_fn, tx,
+                                          backward_passes_per_step=K)
+        params = jax.tree_util.tree_map(jnp.array, params0)
+        opt_state = tx.init(params)
+        per = 6
+        micro = []
+        for _ in range(K):
+            gx = prng.standard_normal((nw * per, 5)).astype(np.float32)
+            gy = np.tanh(gx[:, :4] * 0.7).astype(np.float32)
+            micro.append((gx, gy))
+        for m_i, (gx, gy) in enumerate(micro):
+            lo, hi = rank * per, (rank + 1) * per
+            p_before = np.asarray(params["w"])
+            params, opt_state, _ = step(params, opt_state,
+                                        (gx[lo:hi], gy[lo:hi]))
+            if m_i < K - 1:  # accumulation passes leave params untouched
+                np.testing.assert_array_equal(np.asarray(params["w"]),
+                                              p_before)
+        # reference: mean of the K microbatch grads on the FULL batch,
+        # one plain SGD step at lr/K on the summed (=K*mean) grads.
+        def full_loss(p):
+            return sum(loss_fn(p, m) for m in micro) / K
+
+        g = jax.grad(full_loss)(params0)
+        expect = {"w": params0["w"] - lr * g["w"]}
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   np.asarray(expect["w"]),
+                                   rtol=2e-4, atol=2e-5)
+        print(f"worker {rank}: jax_overlap_accum OK")
+        return 0
+    finally:
         bps_jax.shutdown()
 
 

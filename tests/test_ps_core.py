@@ -245,6 +245,15 @@ def test_jax_overlap_device_wire_compression():
                  timeout=240)
 
 
+def test_jax_overlap_gradient_accumulation():
+    """backward_passes_per_step in the overlap path (reference hook
+    contract): K accumulation passes communicate once and equal one
+    big-batch step exactly; non-final passes leave params untouched."""
+    run_topology(2, 1, WORKER, mode="jax_overlap_accum",
+                 extra={"BYTEPS_PS_MODE": "ps", "XLA_FLAGS": ""},
+                 timeout=180)
+
+
 def test_jax_overlap_stress_4workers_2servers_compressed_multichip():
     """Composition stress: 4 worker processes x 2 virtual chips each,
     2 servers, per-layer overlap (reduce-scattered taps), C-core codec
