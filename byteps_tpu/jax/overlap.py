@@ -141,6 +141,18 @@ class _TapState:
             self.inflight[(idx, j)] = (h, arr)
             self.cv.notify_all()
 
+    def reset_window(self) -> None:
+        """Drop any partial accumulation/in-flight state. Called at the
+        start of each accumulation window: if a previous step crashed
+        mid-backward (device error after some taps fired), leftover
+        acc/acc_count entries would silently mix microbatches from
+        different windows on the next retry — bound the damage to the
+        failed window instead."""
+        with self.cv:
+            self.acc.clear()
+            self.acc_count.clear()
+            self.inflight.clear()
+
     def _pop(self, key: Tuple[int, int], timeout: float):
         """Wait until the tap callback for ``key`` has fired, then take
         its handle. Callbacks are unordered and — on tunneled/remote PJRT
@@ -302,6 +314,9 @@ def make_overlapped_train_step(
             state.declare_all(leaves)
             for i in range(len(leaves)):
                 taps[i] = _make_tap(state, i, axes, k)
+        if micro[0] % backward_passes_per_step == 0:
+            # window start: discard any state a crashed step left behind
+            state.reset_window()
         loss = grad_device(params, batch)
         # Pushes already overlapped the backward pass; the effects barrier
         # flushes any unordered callbacks the runtime hasn't yet run, and

@@ -89,6 +89,108 @@ def gpipe(
     return lax.psum(outputs, axis)
 
 
+def pipeline_1f1b(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    params_local,
+    microbatches: jax.Array,
+    targets: jax.Array,
+    *,
+    axis: str = "pp",
+):
+    """One-forward-one-backward (1F1B) pipeline training schedule.
+
+    GPipe above differentiates through the whole M-tick loop, so every
+    microbatch's activations stay live until the backward pass — O(M)
+    activation memory per device. 1F1B starts each microbatch's backward
+    as soon as the last stage finishes its forward: fwd(m) runs on
+    device d at tick ``m + d`` (GPipe timing), bwd(m) at tick
+    ``m + 2(N-1) - d``, so a stored input lives at most ``2(N-1-d)``
+    ticks and the residual buffer is a fixed ``2N`` slots — **O(N)
+    activation memory, independent of M**. Activations are recomputed
+    from the stored stage INPUT during the backward tick (per-stage
+    remat), the standard 1F1B memory/compute trade. Both ring transfers
+    (activations +1, gradients -1) run unconditionally every tick, so
+    XLA sees one static SPMD program of ``M + 2N - 2`` identical ticks.
+
+    - ``stage_fn(params_local, x) -> y`` — as in :func:`gpipe`.
+    - ``loss_fn(y, target) -> scalar`` — applied to the LAST stage's
+      output per microbatch; the mean over microbatches is returned.
+    - ``microbatches``: [M, ...] replicated input, ``targets``: [M, ...]
+      replicated per-microbatch targets.
+
+    Returns ``(loss, grads_local)``: the mean loss (replicated) and THIS
+    device's stage-parameter gradients (of the mean loss) — apply your
+    optimizer per stage locally; no jax.grad around this is needed.
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    m = microbatches.shape[0]
+    act_shape = microbatches.shape[1:]
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    bwd_perm = [(i, (i - 1) % n) for i in range(n)]
+    r_slots = 2 * n  # max live stored inputs per device is 2(N-1)+1 < 2N
+
+    fwd_carry0 = jnp.zeros(act_shape, microbatches.dtype)
+    bwd_carry0 = jnp.zeros(act_shape, jnp.float32)
+    resid0 = jnp.zeros((r_slots,) + act_shape, microbatches.dtype)
+    grads0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params_local)
+    loss0 = jnp.float32(0.0)
+
+    def tick(t, state):
+        fwd_carry, bwd_carry, resid, grads, loss_acc = state
+
+        # --- forward half: same timing as GPipe -------------------------
+        mb_f = t - idx
+        f_valid = jnp.logical_and(mb_f >= 0, mb_f < m)
+        feed = lax.dynamic_index_in_dim(microbatches,
+                                        jnp.clip(mb_f, 0, m - 1), 0,
+                                        keepdims=False)
+        x = jnp.where(idx == 0, feed, fwd_carry)
+        y = stage_fn(params_local, x)
+        y = jnp.where(f_valid, y, jnp.zeros_like(y))
+        slot_f = jnp.clip(mb_f, 0, None) % r_slots
+        old = lax.dynamic_index_in_dim(resid, slot_f, 0, keepdims=False)
+        resid = lax.dynamic_update_index_in_dim(
+            resid, jnp.where(f_valid, x, old), slot_f, 0)
+        fwd_next = lax.ppermute(y, axis, fwd_perm)
+
+        # --- backward half: bwd(m, d) at tick m + 2(N-1) - d ------------
+        mb_b = t - 2 * (n - 1) + idx
+        b_valid = jnp.logical_and(mb_b >= 0, mb_b < m)
+        slot_b = jnp.clip(mb_b, 0, None) % r_slots
+        x_saved = lax.dynamic_index_in_dim(resid, slot_b, 0,
+                                           keepdims=False)
+        y_b, vjp_fn = jax.vjp(stage_fn, params_local, x_saved)
+        tgt = lax.dynamic_index_in_dim(targets,
+                                       jnp.clip(mb_b, 0, m - 1), 0,
+                                       keepdims=False)
+        # Last stage seeds the gradient chain from the per-microbatch
+        # loss; inner stages consume what the -1 ring delivered (bwd of
+        # the next stage ran exactly one tick earlier — no buffering).
+        loss_m, seed = jax.value_and_grad(
+            lambda yy: loss_fn(yy, tgt) / m)(y_b)
+        g_in = jnp.where(idx == n - 1, seed,
+                         bwd_carry.astype(seed.dtype))
+        g_in = jnp.where(b_valid, g_in, jnp.zeros_like(g_in))
+        dp, dx = vjp_fn(g_in)
+        grads = jax.tree_util.tree_map(
+            lambda a, d: a + d.astype(jnp.float32), grads, dp)
+        loss_acc = loss_acc + jnp.where(
+            jnp.logical_and(b_valid, idx == n - 1), loss_m, 0.0)
+        bwd_next = lax.ppermute(dx.astype(jnp.float32), axis, bwd_perm)
+
+        return fwd_next, bwd_next, resid, grads, loss_acc
+
+    _, _, _, grads, loss_acc = lax.fori_loop(
+        0, m + 2 * n - 2, tick,
+        (fwd_carry0, bwd_carry0, resid0, grads0, loss0))
+    # loss_m was already divided by m; psum replicates the last stage's
+    # accumulated mean to every device (others hold zero).
+    return lax.psum(loss_acc, axis), grads
+
+
 def stage_params(stacked, axis: str = "pp"):
     """Per-device code: pick this device's stage slice from a pytree whose
     leaves are stacked [num_stages, ...]."""

@@ -94,3 +94,57 @@ def test_gpipe_training_matches_sequential(rng):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-6),
         g, g_ref)
+
+
+def test_1f1b_matches_gpipe_gradients():
+    """1F1B (O(N) activation memory, per-stage remat) computes the SAME
+    loss and parameter gradients as differentiating through the GPipe
+    schedule, for M >> N microbatches."""
+    from functools import partial
+
+    from byteps_tpu.parallel.pipeline import pipeline_1f1b
+
+    n, m, d = 4, 12, 6
+    mesh = Mesh(np.asarray(jax.devices()[:n]), ("pp",))
+    rng = np.random.default_rng(13)
+    stacked = {"w": jnp.asarray(rng.standard_normal((n, d, d)),
+                                jnp.float32) * 0.4,
+               "b": jnp.asarray(rng.standard_normal((n, d)),
+                                jnp.float32) * 0.1}
+    mb = jnp.asarray(rng.standard_normal((m, 3, d)), jnp.float32)
+    tgt = jnp.asarray(rng.standard_normal((m, 3, d)), jnp.float32)
+
+    def stage(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    @jax.jit
+    @partial(_shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+             out_specs=(P(), P("pp")), check_vma=False)
+    def run_1f1b(stacked_, mb_, tgt_):
+        loss, grads = pipeline_1f1b(stage, loss_fn, stage_params(stacked_),
+                                    mb_, tgt_)
+        # re-stack each stage's grads for comparison outside
+        return loss, jax.tree_util.tree_map(lambda g: g[None], grads)
+
+    loss_1f1b, grads_1f1b = run_1f1b(stacked, mb, tgt)
+
+    # reference: dense sequential model, plain jax.grad (no pipeline)
+    def sequential(st, x):
+        for i in range(n):
+            x = stage(jax.tree_util.tree_map(lambda w: w[i], st), x)
+        return x
+
+    def total_loss(st):
+        return jnp.mean(jnp.stack(
+            [loss_fn(sequential(st, mb[i]), tgt[i]) for i in range(m)]))
+
+    loss_ref, grads_ref = jax.value_and_grad(total_loss)(stacked)
+    np.testing.assert_allclose(float(loss_1f1b), float(loss_ref),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(grads_1f1b),
+                    jax.tree_util.tree_leaves(grads_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-6)
