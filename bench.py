@@ -65,13 +65,13 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=0, help="global batch "
                    "(default: 64 per chip; bert: 8 per chip)")
-    p.add_argument("--steps", type=int, default=15)
+    p.add_argument("--steps", type=int, default=25)
     p.add_argument("--warmup", type=int, default=5)
-    p.add_argument("--repeats", type=int, default=20,
+    p.add_argument("--repeats", type=int, default=12,
                    help="back-to-back measurement pairs; vs_baseline is "
-                        "the median pair ratio (drift guard: shorter "
-                        "windows pair tighter in time, more pairs "
-                        "stabilise the median)")
+                        "the median pair ratio. 25-step windows measured "
+                        "most stable: shorter ones amplify host-dispatch "
+                        "jitter, longer ones let chip drift into the pair")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--model", choices=["resnet50", "bert"],
                    default="resnet50",

@@ -147,7 +147,13 @@ class _TapState:
         mid-backward (device error after some taps fired), leftover
         acc/acc_count entries would silently mix microbatches from
         different windows on the next retry — bound the damage to the
-        failed window instead."""
+        failed window instead. The effects barrier first flushes any
+        still-queued io_callbacks from the crashed step, so a straggler
+        cannot re-pollute the fresh window right after the clear."""
+        try:
+            jax.effects_barrier()
+        except Exception:
+            pass  # a dead backend can raise here; clearing still helps
         with self.cv:
             self.acc.clear()
             self.acc_count.clear()
@@ -317,20 +323,28 @@ def make_overlapped_train_step(
         if micro[0] % backward_passes_per_step == 0:
             # window start: discard any state a crashed step left behind
             state.reset_window()
-        loss = grad_device(params, batch)
-        # Pushes already overlapped the backward pass; the effects barrier
-        # flushes any unordered callbacks the runtime hasn't yet run, and
-        # collect's cv-wait covers runtimes where even that is lazy.
-        loss.block_until_ready()
-        jax.effects_barrier()
-        micro[0] += 1
-        if micro[0] % backward_passes_per_step:
-            # accumulation pass: gradients summed host-side, nothing on
-            # the wire yet, parameters unchanged
+        try:
+            loss = grad_device(params, batch)
+            # Pushes already overlapped the backward pass; the effects
+            # barrier flushes any unordered callbacks the runtime hasn't
+            # yet run, and collect's cv-wait covers runtimes where even
+            # that is lazy.
+            loss.block_until_ready()
+            jax.effects_barrier()
+            micro[0] += 1
+            if micro[0] % backward_passes_per_step:
+                # accumulation pass: gradients summed host-side, nothing
+                # on the wire yet, parameters unchanged
+                return params, opt_state, loss
+            grads = jax.tree_util.tree_unflatten(treedef,
+                                                 state.collect(leaves))
+            params, opt_state = apply_jit(params, opt_state, grads)
             return params, opt_state, loss
-        grads = jax.tree_util.tree_unflatten(treedef,
-                                             state.collect(leaves))
-        params, opt_state = apply_jit(params, opt_state, grads)
-        return params, opt_state, loss
+        except Exception:
+            # A crash mid-window (some taps fired, counter not advanced)
+            # would double-count the failed pass on retry; roll back to
+            # the window boundary so the next call resets cleanly.
+            micro[0] -= micro[0] % backward_passes_per_step
+            raise
 
     return step

@@ -32,7 +32,8 @@ from byteps_tpu.parallel.moe import moe_dispatch, moe_ffn  # noqa: F401
 from byteps_tpu.parallel.hierarchical import (  # noqa: F401
     quantized_all_reduce,
 )
-from byteps_tpu.parallel.pipeline import gpipe, stage_params  # noqa: F401
+from byteps_tpu.parallel.pipeline import (gpipe, pipeline_1f1b,
+                                           stage_params)  # noqa: F401
 from byteps_tpu.parallel.zero import (  # noqa: F401
     make_zero_train_step,
     zero_apply,
