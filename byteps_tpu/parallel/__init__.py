@@ -28,7 +28,7 @@ from byteps_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_attention,
     ulysses_attention_sharded,
 )
-from byteps_tpu.parallel.moe import moe_dispatch, moe_ffn  # noqa: F401
+from byteps_tpu.parallel.moe import moe_dispatch, moe_dispatch_top2, moe_ffn  # noqa: F401
 from byteps_tpu.parallel.hierarchical import (  # noqa: F401
     quantized_all_reduce,
 )

@@ -21,10 +21,10 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def moe_dispatch(x: jax.Array, gate_logits: jax.Array, capacity: int):
+def moe_dispatch(gate_logits: jax.Array, capacity: int):
     """Top-1 dispatch/combine tensors.
 
-    x: [T, D]; gate_logits: [T, E]. Returns (dispatch [T, E, C] one-hot,
+    gate_logits: [T, E]. Returns (dispatch [T, E, C] one-hot,
     combine [T, E, C] gate-weighted, aux_loss scalar). Tokens beyond an
     expert's capacity are dropped (their combine weights are zero) — the
     standard capacity-factor contract.
@@ -48,6 +48,42 @@ def moe_dispatch(x: jax.Array, gate_logits: jax.Array, capacity: int):
     return dispatch, combine, aux
 
 
+def moe_dispatch_top2(gate_logits: jax.Array, capacity: int):
+    """Top-2 dispatch/combine tensors (GShard's original gating).
+
+    gate_logits: [T, E]. Each token routes to its best TWO experts with
+    combine weights renormalised over the CHOSEN pair (before capacity
+    masking, as in GShard: a dropped second choice forfeits its share
+    rather than re-inflating the first); second choices queue behind all
+    first choices (GShard's position offset), so under capacity pressure
+    first choices win slots. Returns
+    (dispatch [T, E, C], combine [T, E, C], aux_loss).
+    """
+    t, e = gate_logits.shape
+    gates = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    top_v, top_i = lax.top_k(gates, 2)                      # [T, 2]
+    norm = top_v / jnp.maximum(top_v.sum(-1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    fill = jnp.zeros((e,), jnp.float32)  # slots taken by earlier choices
+    for c in range(2):
+        onehot = jax.nn.one_hot(top_i[:, c], e, dtype=jnp.float32)
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0 + fill[None, :]) * onehot
+        keep = onehot.astype(bool) & (pos < capacity)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                              dtype=jnp.float32)
+        d_c = slot * keep[..., None]
+        dispatch = dispatch + d_c
+        combine = combine + d_c * norm[:, c][:, None, None]
+        fill = fill + onehot.sum(axis=0)
+
+    # load balancing on FIRST choices (GShard): fraction routed x mean gate
+    first = jax.nn.one_hot(top_i[:, 0], e, dtype=jnp.float32)
+    aux = (first.mean(0) * gates.mean(0)).sum() * (e ** 2) / e
+    return dispatch, combine, aux
+
+
 def moe_ffn(
     x: jax.Array,
     gate_w: jax.Array,
@@ -56,14 +92,17 @@ def moe_ffn(
     *,
     capacity_factor: float = 1.25,
     ep_axis: Optional[str] = None,
+    top_k: int = 1,
 ):
-    """Top-1 MoE feed-forward.
+    """Top-1 (Switch) or top-2 (GShard) MoE feed-forward.
 
     x: [T, D] (local tokens); gate_w: [D, E]; w1: [E, D, H]; w2: [E, H, D].
     With ``ep_axis`` (size n, per-device code): E must be divisible by n;
     each device holds ALL expert weights but computes only its E/n local
     experts over the globally dispatched slots — pair with a sharded
-    weight layout in real deployments. Returns ([T, D], aux_loss).
+    weight layout in real deployments. ``top_k=2`` routes each token to
+    its two best experts (combine weights renormalised over the pair;
+    size the capacity_factor ~2x accordingly). Returns ([T, D], aux_loss).
     """
     t, d = x.shape
     e = gate_w.shape[1]
@@ -74,7 +113,12 @@ def moe_ffn(
     # of the dense problem (imbalance beyond cf is dropped, by design).
     capacity = max(1, int(capacity_factor * t / e))
 
-    dispatch, combine, aux = moe_dispatch(x, logits, capacity)
+    if top_k == 1:
+        dispatch, combine, aux = moe_dispatch(logits, capacity)
+    elif top_k == 2:
+        dispatch, combine, aux = moe_dispatch_top2(logits, capacity)
+    else:
+        raise ValueError(f"top_k must be 1 or 2, got {top_k}")
     # [T, E, C] x [T, D] -> [E, C, D]
     slots = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
 

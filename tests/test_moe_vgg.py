@@ -85,3 +85,67 @@ def test_moe_capacity_drops_tokens(rng):
     zeros = (np.abs(np.asarray(y)).sum(-1) == 0).sum()
     assert zeros > 0
     assert np.isfinite(np.asarray(y)).all()
+
+
+def test_moe_top2_matches_dense_weighted_sum(rng):
+    """Top-2 gating with ample capacity equals the dense renormalised
+    two-expert mixture exactly (no drops)."""
+    from byteps_tpu.parallel.moe import moe_ffn
+
+    t, d, h, e = 24, 8, 16, 4
+    gate_w = jnp.asarray(rng.standard_normal((d, e)), jnp.float32) * 0.5
+    w1 = jnp.asarray(rng.standard_normal((e, d, h)), jnp.float32) * 0.3
+    w2 = jnp.asarray(rng.standard_normal((e, h, d)), jnp.float32) * 0.3
+    x = jnp.asarray(rng.standard_normal((t, d)), jnp.float32)
+
+    out, aux = moe_ffn(x, gate_w, w1, w2, capacity_factor=2 * e,
+                       top_k=2)
+
+    gates = jax.nn.softmax(np.asarray(x @ gate_w), axis=-1)
+    order = np.argsort(-gates, axis=-1)
+    expect = np.zeros((t, d), np.float32)
+    for i in range(t):
+        e1, e2 = order[i, 0], order[i, 1]
+        g1, g2 = gates[i, e1], gates[i, e2]
+        z = g1 + g2
+        for ee, gg in ((e1, g1 / z), (e2, g2 / z)):
+            hdn = np.asarray(jax.nn.gelu(np.asarray(x)[i] @ np.asarray(w1)[ee]))
+            expect[i] += gg * (hdn @ np.asarray(w2)[ee])
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=2e-4,
+                               atol=2e-5)
+    assert np.isfinite(float(aux))
+
+
+def test_moe_top2_expert_parallel_matches_unsharded(rng):
+    """Top-2 EP dispatch over the ep axis equals the unsharded result."""
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from byteps_tpu.jax._compat import shard_map as _shard_map
+    from byteps_tpu.parallel.moe import moe_ffn
+
+    n = 4
+    mesh = Mesh(np.asarray(jax.devices()[:n]), ("ep",))
+    t, d, h, e = 8 * n, 8, 16, n
+    gate_w = jnp.asarray(rng.standard_normal((d, e)), jnp.float32) * 0.5
+    w1 = jnp.asarray(rng.standard_normal((e, d, h)), jnp.float32) * 0.3
+    w2 = jnp.asarray(rng.standard_normal((e, h, d)), jnp.float32) * 0.3
+    x = jnp.asarray(rng.standard_normal((t, d)), jnp.float32)
+
+    @partial(_shard_map, mesh=mesh,
+             in_specs=(P("ep"), P(), P(), P()), out_specs=(P("ep"), P()),
+             check_vma=False)
+    def ep_run(xl, gw, w1_, w2_):
+        out, aux = moe_ffn(xl, gw, w1_, w2_, capacity_factor=2 * e,
+                           ep_axis="ep", top_k=2)
+        return out, jax.lax.pmean(aux, "ep")
+
+    out_ep, _ = ep_run(x, gate_w, w1, w2)
+    out_ref, _ = moe_ffn(x, gate_w, w1, w2, capacity_factor=2 * e,
+                         top_k=2)
+    # per-device dispatch: same tokens, same experts, same math — but the
+    # sharded run computes capacity per local token count; ample factor
+    # makes both drop-free, so results agree exactly.
+    np.testing.assert_allclose(np.asarray(out_ep), np.asarray(out_ref),
+                               rtol=2e-4, atol=2e-5)
