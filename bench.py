@@ -67,11 +67,13 @@ def main() -> None:
                    "(default: 64 per chip; bert: 8 per chip)")
     p.add_argument("--steps", type=int, default=25)
     p.add_argument("--warmup", type=int, default=5)
-    p.add_argument("--repeats", type=int, default=12,
+    p.add_argument("--repeats", type=int, default=None,
                    help="back-to-back measurement pairs; vs_baseline is "
                         "the median pair ratio. 25-step windows measured "
                         "most stable: shorter ones amplify host-dispatch "
-                        "jitter, longer ones let chip drift into the pair")
+                        "jitter, longer ones let chip drift into the pair. "
+                        "Default: 12 (resnet) / 6 (bert — its compiles "
+                        "dominate wall time)")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--model", choices=["resnet50", "bert"],
                    default="resnet50",
@@ -81,7 +83,11 @@ def main() -> None:
                    help="tiny shapes for a fast correctness pass")
     args = p.parse_args()
     if args.model == "bert":
+        if args.repeats is None:
+            args.repeats = 6
         return bench_bert(args)
+    if args.repeats is None:
+        args.repeats = 12
 
     _maybe_force_cpu()
     import jax
