@@ -31,6 +31,11 @@ PS keys (``{name}.{j}``), preserving declaration-order priority
 Priorities follow parameter declaration order (flattened tree order =
 front-of-model first for standard model pytrees), so early layers' pulls
 complete first — exactly the reference's scheduling rationale.
+
+Options: ``wire_dtype`` compresses the device->host transfer inside jit
+(bf16 2x / int8+scales ~4x, re-expanded to f32 before the PS push);
+``backward_passes_per_step`` accumulates K backward passes host-side and
+communicates once (the reference's gradient-accumulation contract).
 """
 
 from __future__ import annotations
