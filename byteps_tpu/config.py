@@ -116,14 +116,23 @@ class Config:
             raise ValueError(
                 "BYTEPS_SCHEDULING_CREDIT is a byte budget; must be >= 0 "
                 "(0 = auto: 4 x BYTEPS_PARTITION_BYTES)")
-        if 0 < self.scheduling_credit < 65536:
+        if 0 < self.scheduling_credit < 1024:
             # A handful of BYTES can only be a legacy partition-count
-            # value; silently honouring it would serialise every push.
-            raise ValueError(
+            # value; honouring it as bytes would serialise every push.
+            # Warn here but do NOT rewrite the value: the C core is the
+            # single conversion point (worker.cc interprets any value
+            # < 1024 as a partition count and multiplies by
+            # partition_bytes). Converting in both layers would compose,
+            # and would make validate() non-idempotent. Values >= 1024
+            # are honoured as genuine byte budgets.
+            import warnings
+            warnings.warn(
                 f"BYTEPS_SCHEDULING_CREDIT={self.scheduling_credit} looks "
-                "like a legacy partition count; it is now an in-flight "
-                "BYTE budget (reference semantics). Set 0 for auto "
-                "(4 x BYTEPS_PARTITION_BYTES) or a value >= 65536.")
+                "like a legacy in-flight partition count; the core will "
+                f"interpret it as {self.scheduling_credit} x "
+                f"{self.partition_bytes} bytes (it is now a BYTE budget; "
+                "set 0 for auto = 4 x BYTEPS_PARTITION_BYTES)",
+                stacklevel=2)
         if self.num_worker < 1:
             raise ValueError("DMLC_NUM_WORKER must be >= 1")
         if self.ps_mode not in ("auto", "collective", "ps"):

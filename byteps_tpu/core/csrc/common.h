@@ -10,6 +10,7 @@
 #include <cstring>
 #include <memory>
 #include <string>
+#include <utility>
 #include <vector>
 
 namespace bps {
@@ -102,8 +103,23 @@ struct MsgHeader {
 class Bytes {
  public:
   Bytes() = default;
-  Bytes(Bytes&&) = default;
-  Bytes& operator=(Bytes&&) = default;
+  // Explicit moves: a defaulted move would copy len_/cap_, leaving the
+  // moved-from object claiming nonzero size with null data_ — a later
+  // resize_uninit(n <= cap_) on it would hand out data()==nullptr with
+  // size()>0. Messages move through parked_pushes and back; keep the
+  // moved-from state honest (empty).
+  Bytes(Bytes&& other) noexcept
+      : data_(std::move(other.data_)),
+        len_(std::exchange(other.len_, 0)),
+        cap_(std::exchange(other.cap_, 0)) {}
+  Bytes& operator=(Bytes&& other) noexcept {
+    if (this != &other) {
+      data_ = std::move(other.data_);
+      len_ = std::exchange(other.len_, 0);
+      cap_ = std::exchange(other.cap_, 0);
+    }
+    return *this;
+  }
 
   void resize_uninit(size_t n) {
     if (n > cap_) {

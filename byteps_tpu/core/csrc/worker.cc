@@ -23,16 +23,21 @@ void BytePSWorker::Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
   default_comp_ = std::move(default_comp);
   trace_on_ = trace_on;
   // Reference semantics: BYTEPS_SCHEDULING_CREDIT is an in-flight BYTE
-  // budget. 0 = auto: four full partitions' worth. A tiny positive value
-  // can only be a legacy partition count — honouring it as bytes would
-  // serialise every push, so floor it loudly (the Python config layer
-  // rejects such values outright).
-  if (credit_bytes > 0 && credit_bytes < 65536) {
+  // budget. 0 = auto: four full partitions' worth. A value under 1024
+  // can only be a legacy partition count (the reference default was 4;
+  // no real byte budget is smaller than 1 KiB, and no in-flight count
+  // reaches 1024) — honouring it as bytes would serialise every push,
+  // so interpret it AS a partition count (credit × partition_bytes) so
+  // legacy env users keep their intended overlap. Values >= 1024 are
+  // honoured as bytes, so small genuine budgets stay expressible.
+  // This is the SINGLE conversion point: the Python config layer warns
+  // about sub-1024 values but passes them through unchanged.
+  if (credit_bytes > 0 && credit_bytes < 1024) {
     BPS_LOG(WARNING) << "BYTEPS_SCHEDULING_CREDIT=" << credit_bytes
-                     << " bytes looks like a legacy partition count; "
-                     << "flooring to one partition (" << partition_bytes
-                     << " bytes)";
-    credit_bytes = partition_bytes;
+                     << " looks like a legacy in-flight partition count; "
+                     << "interpreting as " << credit_bytes << " x "
+                     << partition_bytes << " bytes";
+    credit_bytes = credit_bytes * partition_bytes;
   }
   if (credit_bytes <= 0) credit_bytes = 4 * partition_bytes;
   queue_ = std::make_unique<ScheduledQueue>(credit_bytes);

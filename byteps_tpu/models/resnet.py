@@ -5,6 +5,12 @@ ResNet-50 (SURVEY.md §2.6, BASELINE.md config 1). TPU-first choices:
 bfloat16 activations/weights by default (MXU-native), NHWC layout (TPU
 convolution layout), static shapes, BatchNorm with mutable batch_stats
 handled functionally.
+
+Attribution: the module structure (ResNetBlock/BottleneckResNetBlock
+split, conv_proj/norm_proj projection naming, zeros-initialised final BN
+scale, ModuleDef pattern) follows the canonical Flax ImageNet example
+(github.com/google/flax, examples/imagenet/models.py, Apache-2.0) — the
+quasi-standard JAX ResNet formulation — not the task reference.
 """
 
 from __future__ import annotations

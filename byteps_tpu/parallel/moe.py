@@ -21,14 +21,43 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def moe_dispatch(gate_logits: jax.Array, capacity: int):
+def moe_dispatch(gate_logits: jax.Array, capacity: int,
+                 _legacy_capacity: Optional[int] = None):
     """Top-1 dispatch/combine tensors.
 
     gate_logits: [T, E]. Returns (dispatch [T, E, C] one-hot,
     combine [T, E, C] gate-weighted, aux_loss scalar). Tokens beyond an
     expert's capacity are dropped (their combine weights are zero) — the
     standard capacity-factor contract.
+
+    Accepts the pre-0.2 POSITIONAL 3-arg form ``moe_dispatch(x,
+    gate_logits, capacity)`` (the token tensor was never used by the
+    dispatch math) with a DeprecationWarning; remove the leading ``x``
+    argument. Legacy calls that passed any of those args by keyword are
+    not shimmed — they fail with Python's own "multiple values"
+    TypeError at the call site.
     """
+    if _legacy_capacity is not None:
+        import warnings
+        warnings.warn(
+            "moe_dispatch(x, gate_logits, capacity) is deprecated; the "
+            "leading token tensor was dropped — call "
+            "moe_dispatch(gate_logits, capacity)",
+            DeprecationWarning, stacklevel=2)
+        gate_logits, capacity = capacity, _legacy_capacity
+    import operator
+    try:
+        capacity = operator.index(capacity)  # any int-like, incl. 0-d jnp int
+    except TypeError:
+        # Catches any call where capacity ends up a tensor (e.g. a legacy
+        # positional call that slipped the gate logits into this slot)
+        # before it turns into a confusing deep-in-JAX error.
+        raise TypeError(
+            "moe_dispatch capacity must be a static int; got "
+            f"{type(capacity).__name__}. Note the signature changed from "
+            "moe_dispatch(x, gate_logits, capacity) to "
+            "moe_dispatch(gate_logits, capacity) — drop the leading token "
+            "tensor.") from None
     t, e = gate_logits.shape
     gates = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
     expert = jnp.argmax(gates, axis=-1)                    # [T]

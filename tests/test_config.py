@@ -17,14 +17,20 @@ def test_defaults(monkeypatch):
     assert not cfg.use_ps
 
 
-def test_legacy_partition_count_credit_rejected(monkeypatch):
+def test_legacy_partition_count_credit_warns_passthrough(monkeypatch):
     """BYTEPS_SCHEDULING_CREDIT is now a byte budget; a tiny value can
-    only be a legacy partition count and must fail loudly instead of
-    silently serialising every push."""
+    only be a legacy partition count. The Python layer warns but passes
+    the value through unchanged — the C core is the single conversion
+    point (credit x partition_bytes), so the two layers can never
+    compose a double conversion and validate() stays idempotent."""
     monkeypatch.setenv("BYTEPS_SCHEDULING_CREDIT", "4")
     import pytest
-    with pytest.raises(ValueError, match="byte budget|BYTE budget"):
-        load_config().validate()
+    with pytest.warns(UserWarning, match="legacy in-flight partition"):
+        cfg = load_config().validate()
+    assert cfg.scheduling_credit == 4
+    with pytest.warns(UserWarning):
+        cfg.validate()  # idempotent: same warning, value still unchanged
+    assert cfg.scheduling_credit == 4
 
 
 def test_env_parity_names(monkeypatch):

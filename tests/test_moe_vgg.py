@@ -149,3 +149,19 @@ def test_moe_top2_expert_parallel_matches_unsharded(rng):
     # makes both drop-free, so results agree exactly.
     np.testing.assert_allclose(np.asarray(out_ep), np.asarray(out_ref),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_moe_dispatch_legacy_3arg_shim(rng):
+    """Pre-0.2 callers passed (x, gate_logits, capacity); the token tensor
+    was never used by the dispatch math. The shim must honour the old call
+    with a DeprecationWarning and return identical tensors."""
+    from byteps_tpu.parallel.moe import moe_dispatch
+
+    logits = jax.random.normal(jax.random.PRNGKey(0), (16, 4))
+    x = jnp.ones((16, 8))
+    d_new, c_new, aux_new = moe_dispatch(logits, 4)
+    with pytest.warns(DeprecationWarning):
+        d_old, c_old, aux_old = moe_dispatch(x, logits, 4)
+    np.testing.assert_array_equal(np.asarray(d_new), np.asarray(d_old))
+    np.testing.assert_array_equal(np.asarray(c_new), np.asarray(c_old))
+    np.testing.assert_array_equal(np.asarray(aux_new), np.asarray(aux_old))
