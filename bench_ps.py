@@ -1,0 +1,347 @@
+"""PS-mode benchmark on the REAL chip: plain vs push_pull vs overlapped.
+
+VERDICT r2 missing #1: every PS/overlap number so far came from virtual-CPU
+topologies; the reference's headline numbers are real-hardware PS-mode
+numbers (SURVEY.md §3.3 hot path). This script runs the actual bench-host
+topology — THIS process is the single TPU worker, and it self-provisions a
+localhost fleet (scheduler + CPU server processes, which never import JAX
+and so never touch the chip) — then measures, per model:
+
+  plain          fused jitted train step, no sync framework (baseline)
+  ps             make_train_step in PS mode: jit grad -> batched D2H ->
+                 C-core push/pull over TCP -> H2D -> jit apply
+  overlap        make_overlapped_train_step: per-parameter io_callback taps
+                 stream pushes DURING backward (wire f32)
+  overlap_bf16   same with in-jit bf16 wire cast (half the D2H bytes)
+
+plus the host-boundary microbenchmarks the staging design rests on:
+d2h_gbps / h2d_gbps for one gradient-sized transfer.
+
+Prints one JSON line per measurement and, with --out, writes the list as a
+committed artifact (BENCH_ps_r03.json). Steps/sec ratios are back-to-back
+per repeat (median ratio), the drift-robust methodology from bench.py.
+
+Run: python bench_ps.py --model resnet50 --out BENCH_ps_r03.json
+     (add --trace trace.json for a BYTEPS_TRACE_ON timeline capture)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def provision_fleet(num_servers: int, trace_on: bool):
+    """Spawn scheduler + servers; point THIS process at them as worker 0."""
+    port = _free_port()
+    base = {
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": str(num_servers),
+        "PS_HEARTBEAT_INTERVAL": "5",
+        # XLA compiles saturate this host's core(s) for minutes at a time;
+        # with the default 30 s timeout the scheduler's failure detector
+        # reads that starvation as node death mid-benchmark and fail-stops
+        # the fleet. The detector is exercised by tests/test_aux.py; here
+        # it must stay out of the measurement's way.
+        "PS_HEARTBEAT_TIMEOUT": "600",
+    }
+    procs = []
+    for role, n in (("scheduler", 1), ("server", num_servers)):
+        for _ in range(n):
+            env = dict(os.environ)
+            env.update(base)
+            env["DMLC_ROLE"] = role
+            env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__))
+                                 + os.pathsep + env.get("PYTHONPATH", ""))
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.server"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT))
+    os.environ.update(base)
+    os.environ["DMLC_ROLE"] = "worker"
+    os.environ["DMLC_WORKER_ID"] = "0"
+    os.environ["BYTEPS_PS_MODE"] = "ps"
+    os.environ["BYTEPS_FORCE_DISTRIBUTED"] = "1"
+    if trace_on:
+        os.environ["BYTEPS_TRACE_ON"] = "1"
+    return procs
+
+
+def _sync(x):
+    """Force completion, not just dispatch (tunneled-PJRT quirk)."""
+    import jax
+    import numpy as np
+    jax.block_until_ready(x)
+    leaves = jax.tree_util.tree_leaves(x)
+    np.asarray(jax.numpy.ravel(leaves[-1])[0])
+
+
+def _time_steps(step, state, batch, steps: int):
+    """Seconds per step for step(*state, batch) -> (*state, loss)."""
+    state = step(*state, batch)   # warm / compile
+    state = step(*state[:-1], batch)
+    _sync(state)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state = step(*state[:-1], batch)
+    _sync(state)
+    return (time.perf_counter() - t0) / steps
+
+
+def host_boundary_microbench(nbytes: int):
+    """D2H / H2D GB/s for one contiguous gradient-sized f32 transfer."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    n = nbytes // 4
+    dev = jax.jit(lambda k: jax.random.normal(k, (n,)))(jax.random.PRNGKey(0))
+    _sync(dev)
+    t0 = time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        host = jax.device_get(dev)
+    d2h = nbytes * reps / (time.perf_counter() - t0)
+    host = np.ascontiguousarray(host)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        back = jax.device_put(host)
+        _sync(back)
+    h2d = nbytes * reps / (time.perf_counter() - t0)
+    return d2h / 1e9, h2d / 1e9
+
+
+def build_model(name: str, batch: int, seq_len: int, smoke: bool):
+    """Returns (loss_fn(params, batch)->scalar, params, batch_arrays,
+    items_per_step, grad_bytes)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    if name == "resnet50":
+        from byteps_tpu.jax.flax_util import cross_entropy_loss
+        from byteps_tpu.models import ResNet18, ResNet50
+        cls, img = (ResNet18, 64) if smoke else (ResNet50, 224)
+        model = cls(num_classes=1000, dtype=jnp.bfloat16)
+        x = jnp.asarray(rng.standard_normal((batch, img, img, 3)),
+                        jnp.float32)
+        y = jnp.asarray(rng.integers(0, 1000, batch), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), x[:1], train=False)
+        stats = variables["batch_stats"]
+
+        # BatchNorm statistics are computed from the batch in train mode
+        # but their running-average update is discarded: all three paths
+        # (plain / ps / overlap) then share one loss_fn(params, batch)
+        # signature, so the comparison isolates gradient-sync cost.
+        def loss_fn(p, b):
+            bx, by = b
+            out, _ = model.apply({"params": p, "batch_stats": stats}, bx,
+                                 train=True, mutable=["batch_stats"])
+            return cross_entropy_loss(out, by)
+
+        params = variables["params"]
+        data = (x, y)
+        items = batch
+    elif name == "gpt2":
+        from byteps_tpu.models import GPT2Small, TransformerLM, lm_loss
+        if smoke:
+            model = TransformerLM(num_layers=2, d_model=128, num_heads=4,
+                                  mlp_dim=256, vocab_size=1024, max_len=256,
+                                  dtype=jnp.bfloat16)
+        else:
+            model = GPT2Small(dtype=jnp.bfloat16)
+        toks = jnp.asarray(rng.integers(0, 1000, (batch, seq_len)),
+                           jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), toks[:1])
+
+        def loss_fn(p, b):
+            return lm_loss(model.apply(p, b), b)
+
+        data = toks
+        items = batch
+    else:
+        raise SystemExit(f"unknown model {name!r}")
+
+    grad_bytes = sum(
+        int(np.size(l)) * 4 for l in jax.tree_util.tree_leaves(params))
+    return loss_fn, params, data, items, grad_bytes
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", choices=["resnet50", "gpt2"],
+                   default="resnet50")
+    p.add_argument("--batch", type=int, default=0,
+                   help="default: 64 (resnet50) / 8 (gpt2)")
+    p.add_argument("--seq-len", type=int, default=512)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--repeats", type=int, default=3,
+                   help="back-to-back measurement rounds; ratios use the "
+                        "median across rounds")
+    p.add_argument("--num-servers", type=int, default=1,
+                   help="CPU server processes (this VM has 1 core; >1 adds "
+                        "contention, not parallelism)")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny model + CPU-friendly shapes, quick pass")
+    p.add_argument("--skip", default="",
+                   help="comma-separated paths to skip (e.g. ps,overlap)")
+    p.add_argument("--out", default="", help="write JSON artifact here")
+    p.add_argument("--trace", default="",
+                   help="write a BYTEPS_TRACE_ON timeline JSON here")
+    args = p.parse_args()
+    batch = args.batch or {"resnet50": 64, "gpt2": 8}[args.model]
+    if args.smoke:
+        batch = min(batch, 8)
+        args.steps = min(args.steps, 3)
+
+    if (os.environ.get("JAX_PLATFORMS", "").lower() == "cpu"
+            and "host_platform_device_count" not in
+            os.environ.get("XLA_FLAGS", "")):
+        # One CPU device == one async-work thread in the XLA:CPU client;
+        # the overlap taps' io_callbacks then deadlock under load (see
+        # make_overlapped_train_step's warning). Must be set before jax
+        # imports anywhere below.
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
+
+    fleet = provision_fleet(args.num_servers, bool(args.trace))
+    results = []
+    try:
+        if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        import jax
+        import numpy as np
+        import optax
+
+        import byteps_tpu.jax as bps
+        from byteps_tpu.jax.overlap import make_overlapped_train_step
+        from byteps_tpu.jax.training import make_train_step
+
+        loss_fn, params, data, items, grad_bytes = build_model(
+            args.model, batch, args.seq_len, args.smoke)
+        tx = optax.sgd(0.1, momentum=0.9)
+        platform = jax.devices()[0].platform
+
+        d2h, h2d = host_boundary_microbench(grad_bytes)
+        results.append({"metric": "host_d2h_gbps", "value": round(d2h, 3),
+                        "unit": "GB/s", "bytes": grad_bytes})
+        results.append({"metric": "host_h2d_gbps", "value": round(h2d, 3),
+                        "unit": "GB/s", "bytes": grad_bytes})
+        print(json.dumps(results[-2]))
+        print(json.dumps(results[-1]))
+
+        bps.init()
+        host_params = jax.tree_util.tree_map(np.asarray, params)
+
+        def fresh_state():
+            ps = jax.tree_util.tree_map(jax.numpy.array, host_params)
+            return (ps, tx.init(ps))
+
+        # plain fused step: the no-framework baseline
+        @jax.jit
+        def plain_step(p_, opt_state, b):
+            loss, g = jax.value_and_grad(loss_fn)(p_, b)
+            u, opt_state = tx.update(g, opt_state, p_)
+            return optax.apply_updates(p_, u), opt_state, loss
+
+        all_paths = {
+            "plain": lambda: plain_step,
+            "ps": lambda: make_train_step(loss_fn, tx, bps.mesh(),
+                                          donate=False),
+            "overlap": lambda: make_overlapped_train_step(
+                loss_fn, tx, prefix="of32"),
+            "overlap_bf16": lambda: make_overlapped_train_step(
+                loss_fn, tx, wire_dtype="bfloat16", prefix="obf16"),
+        }
+        skip = set(s for s in args.skip.split(",") if s)
+        unknown = skip - set(all_paths)
+        if unknown:
+            raise SystemExit(f"--skip: unknown path(s) {sorted(unknown)}; "
+                             f"choose from {sorted(all_paths)}")
+        if "plain" in skip:
+            raise SystemExit("--skip plain: the plain step is the ratio "
+                             "baseline and cannot be skipped")
+        paths = {n: f for n, f in all_paths.items() if n not in skip}
+
+        # Back-to-back rounds: each round times every path once, so chip /
+        # host drift lands inside a round and the per-round ratios cancel
+        # it (bench.py's pair-median methodology, generalised).
+        times = {name: [] for name in paths}
+        built = {name: make() for name, make in paths.items()}
+        for _ in range(args.repeats):
+            for name, step in built.items():
+                times[name].append(
+                    _time_steps(step, fresh_state(), data, args.steps))
+        for name in paths:
+            med = statistics.median(times[name])
+            ratios = [tp / t for tp, t in zip(times["plain"], times[name])]
+            rec = {
+                "metric": f"{args.model}_{name}_items_per_sec",
+                "value": round(items / med, 2),
+                "unit": ("images/sec" if args.model == "resnet50"
+                         else "sequences/sec"),
+                "step_ms": round(med * 1e3, 1),
+                "vs_plain": round(statistics.median(ratios), 4),
+                "platform": platform,
+                "batch": batch,
+                "grad_mbytes": round(grad_bytes / 1e6, 1),
+            }
+            results.append(rec)
+            print(json.dumps(rec))
+
+        if args.trace and "overlap" in built:
+            # Dedicated trace pass: the Timeline helper merges jax.profiler
+            # device spans with the C core's push/pull spans over the
+            # BYTEPS_TRACE_START/END_STEP window (docs/timeline.md).
+            from byteps_tpu.utils import Timeline
+            from byteps_tpu.config import get_config
+            cfg = get_config(reload=True)
+            tl = Timeline()
+            stepf = built["overlap"]
+            out = stepf(*fresh_state(), data)
+            tl.step()
+            for _ in range(cfg.trace_end_step):
+                out = stepf(*out[:-1], data)
+                tl.step()
+            tl.close()
+            combined = os.path.join(cfg.trace_dir, "combined_rank0.json")
+            if os.path.exists(combined) and combined != args.trace:
+                os.replace(combined, args.trace)
+            print(json.dumps({"trace": args.trace}))
+
+        bps.shutdown()
+        for pr in fleet:
+            pr.wait(timeout=30)
+    finally:
+        for pr in fleet:
+            if pr.poll() is None:
+                pr.kill()
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"model": args.model, "batch": batch,
+                       "steps": args.steps, "repeats": args.repeats,
+                       "num_servers": args.num_servers,
+                       "platform": platform,
+                       "results": results}, f, indent=1)
+        print(json.dumps({"artifact": args.out}))
+
+
+if __name__ == "__main__":
+    main()

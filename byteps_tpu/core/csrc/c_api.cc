@@ -43,6 +43,10 @@ struct Global {
   // Finalize call is then an idempotent no-op.
   ~Global() {
     if (!inited) return;
+    // Drain the callback executor FIRST: queued completions touch the
+    // BytePSWorker (credit release, handle counts), which is destroyed
+    // before the KVWorker in reverse member order.
+    if (kv) kv->StopExec();
     if (worker) worker->Stop();
     if (po) po->Finalize();
     if (server) server->Stop();
@@ -128,7 +132,8 @@ int bps_init(int role) {
       gl->server->Handle(std::move(m), fd);
     };
   } else if (gl->role == ROLE_WORKER) {
-    gl->kv = std::make_unique<KVWorker>(gl->po.get());
+    gl->kv = std::make_unique<KVWorker>(
+        gl->po.get(), EnvInt("BYTEPS_WORKER_CALLBACK_THREADS", 4));
     handler = [gl](Message&& m, int fd) {
       (void)fd;
       gl->kv->OnResponse(std::move(m));
@@ -151,6 +156,8 @@ int bps_init(int role) {
 void bps_finalize() {
   Global* gl = g();
   if (!gl->inited) return;
+  // Same drain-first order as ~Global (see its comment).
+  if (gl->kv) gl->kv->StopExec();
   if (gl->worker) gl->worker->Stop();
   gl->po->Finalize();
   if (gl->server) gl->server->Stop();

@@ -8,8 +8,11 @@
 #pragma once
 
 #include <condition_variable>
+#include <deque>
 #include <functional>
+#include <memory>
 #include <mutex>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -23,9 +26,39 @@ class KVWorker {
  public:
   using Callback = std::function<void(Message&&)>;
 
-  explicit KVWorker(Postoffice* po) : po_(po) {}
+  // Response callbacks run on a small key-hashed executor pool, NEVER on
+  // the van receive threads. A callback may send (the push→pull chain
+  // issues CMD_PULL from the push-ack callback); a send can block on a
+  // full socket, and a recv thread blocked in a send stops reading — the
+  // classic bidirectional-TCP deadlock (worker blocked sending to a
+  // server whose sends to the worker have filled both kernel buffers,
+  // each side's reader wedged behind its writer). Key-hashing keeps one
+  // key's chain (push ack → pull → pull resp) totally ordered, matching
+  // the server's per-key engine queues (server.cc:24-33).
+  explicit KVWorker(Postoffice* po, int exec_threads = 4) : po_(po) {
+    exec_queues_.resize(exec_threads < 1 ? 1 : exec_threads);
+    for (auto& q : exec_queues_) q = std::make_unique<ExecQueue>();
+    for (size_t i = 0; i < exec_queues_.size(); ++i) {
+      exec_threads_.emplace_back([this, i] { ExecLoop(i); });
+    }
+  }
 
-  // Issue a request to `node_id`; `cb` fires on the van receive thread when
+  ~KVWorker() { StopExec(); }
+
+  // Drain queued callbacks, then stop the executor threads. Idempotent.
+  void StopExec() {
+    for (auto& q : exec_queues_) {
+      std::lock_guard<std::mutex> lk(q->mu);
+      q->stop = true;
+      q->cv.notify_all();
+    }
+    for (auto& t : exec_threads_) {
+      if (t.joinable()) t.join();
+    }
+    exec_threads_.clear();
+  }
+
+  // Issue a request to `node_id`; `cb` fires on an executor thread when
   // the matching response (same req_id) arrives. Returns the req id.
   int Request(int node_id, MsgHeader head, const void* payload,
               int64_t payload_len, Callback cb) {
@@ -42,6 +75,8 @@ class KVWorker {
   }
 
   // Route a response message (PUSH_ACK / PULL_RESP / INIT_ACK / ...).
+  // Runs on the van receive thread: must not block and must not send —
+  // just settle the request table and hand the callback to the executor.
   void OnResponse(Message&& msg) {
     Callback cb;
     {
@@ -52,8 +87,15 @@ class KVWorker {
       pending_.erase(it);
       done_count_++;
     }
-    if (cb) cb(std::move(msg));
     cv_.notify_all();
+    if (!cb) return;
+    auto& q = *exec_queues_[static_cast<size_t>(msg.head.key) %
+                            exec_queues_.size()];
+    {
+      std::lock_guard<std::mutex> lk(q.mu);
+      q.items.emplace_back(std::move(cb), std::move(msg));
+    }
+    q.cv.notify_one();
   }
 
   // Block until there are no outstanding requests.
@@ -92,12 +134,36 @@ class KVWorker {
   }
 
  private:
+  struct ExecQueue {
+    std::mutex mu;
+    std::condition_variable cv;
+    std::deque<std::pair<Callback, Message>> items;
+    bool stop = false;
+  };
+
+  void ExecLoop(size_t idx) {
+    auto& q = *exec_queues_[idx];
+    for (;;) {
+      std::pair<Callback, Message> item;
+      {
+        std::unique_lock<std::mutex> lk(q.mu);
+        q.cv.wait(lk, [&q] { return q.stop || !q.items.empty(); });
+        if (q.items.empty()) return;  // stop requested and fully drained
+        item = std::move(q.items.front());
+        q.items.pop_front();
+      }
+      item.first(std::move(item.second));
+    }
+  }
+
   Postoffice* po_;
   std::mutex mu_;
   std::condition_variable cv_;
   std::unordered_map<int, Callback> pending_;
   int next_req_id_ = 0;
   int64_t done_count_ = 0;
+  std::vector<std::unique_ptr<ExecQueue>> exec_queues_;
+  std::vector<std::thread> exec_threads_;
 };
 
 }  // namespace bps

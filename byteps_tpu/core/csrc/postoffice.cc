@@ -302,7 +302,24 @@ void Postoffice::HeartbeatLoop() {
       if (it == node_fd_.end()) break;
       fd = it->second;
     }
-    if (!van_->Send(fd, h)) break;
+    if (!van_->Send(fd, h)) {
+      // The scheduler connection is gone. For a server this is the ONLY
+      // exit signal once Finalize's indefinite wait has begun (the
+      // SHUTDOWN broadcast can never arrive over a dead connection), and
+      // for a worker it means the fleet is over: treat it as a
+      // failure-triggered shutdown rather than spinning silently.
+      if (!shutting_down_.load()) {
+        BPS_LOG(WARNING) << "node " << my_id_
+                         << ": scheduler connection lost — failure shutdown";
+        shutting_down_.store(true);
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          cv_.notify_all();
+        }
+        if (shutdown_cb_) shutdown_cb_();
+      }
+      break;
+    }
     for (int i = 0; i < static_cast<int>(interval * 10) &&
                     !shutting_down_.load();
          ++i) {
@@ -345,18 +362,26 @@ void Postoffice::Finalize() {
                  [this] { return shutting_down_.load(); });
     lk.unlock();
     van_->Stop();
-  } else if (role_ == ROLE_SCHEDULER) {
-    // Wait for all workers' goodbyes (handled in ControlHandler).
-    std::unique_lock<std::mutex> lk(mu_);
-    cv_.wait_for(lk, std::chrono::seconds(30),
-                 [this] { return shutting_down_.load(); });
-    lk.unlock();
-    van_->Stop();
   } else {
-    // Server: wait for SHUTDOWN broadcast.
+    // Scheduler: wait for all workers' goodbyes (handled in
+    // ControlHandler) — for as long as the job runs. This wait IS the
+    // scheduler's serving life (`python -m byteps_tpu.server` calls
+    // shutdown() right after startup); a bounded wait here silently
+    // killed any fleet whose job outlived the bound. The failure monitor
+    // is the other exit: dead nodes trigger the fail-stop broadcast.
+    // Server: same indefinite wait for the SHUTDOWN broadcast; if the
+    // scheduler dies instead, the heartbeat loop notices the dead
+    // connection and flips shutting_down_ (failure shutdown).
+    // With heartbeats DISABLED (PS_HEARTBEAT_INTERVAL <= 0) neither
+    // failure exit exists, so keep the old bounded grace as the only
+    // defence against orphaned fleet processes.
     std::unique_lock<std::mutex> lk(mu_);
-    cv_.wait_for(lk, std::chrono::seconds(30),
-                 [this] { return shutting_down_.load(); });
+    if (EnvSeconds("PS_HEARTBEAT_INTERVAL", 5.0) > 0) {
+      cv_.wait(lk, [this] { return shutting_down_.load(); });
+    } else {
+      cv_.wait_for(lk, std::chrono::seconds(30),
+                   [this] { return shutting_down_.load(); });
+    }
     lk.unlock();
     van_->Stop();
   }

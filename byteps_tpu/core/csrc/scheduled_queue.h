@@ -15,12 +15,22 @@
 
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <functional>
 #include <mutex>
 #include <queue>
 #include <vector>
 
 namespace bps {
+
+inline bool QueueDebug() {
+  static const bool on = [] {
+    const char* v = getenv("BYTEPS_QUEUE_DEBUG");
+    return v && *v && *v != '0';
+  }();
+  return on;
+}
 
 struct Task {
   int priority = 0;       // higher = sooner
@@ -44,6 +54,11 @@ class ScheduledQueue {
   void Push(Task t) {
     std::lock_guard<std::mutex> lk(mu_);
     t.seq = seq_++;
+    if (QueueDebug()) {
+      fprintf(stderr, "[QDEBUG] push key=%lld bytes=%lld inflight=%lld "
+              "pending=%zu\n", (long long)t.key, (long long)t.bytes,
+              (long long)inflight_bytes_, heap_.size() + 1);
+    }
     heap_.push(std::move(t));
     cv_.notify_one();
   }
@@ -63,6 +78,11 @@ class ScheduledQueue {
     *out = heap_.top();
     heap_.pop();
     inflight_bytes_ += out->bytes;
+    if (QueueDebug()) {
+      fprintf(stderr, "[QDEBUG] pop key=%lld bytes=%lld inflight=%lld "
+              "pending=%zu\n", (long long)out->key, (long long)out->bytes,
+              (long long)inflight_bytes_, heap_.size());
+    }
     return true;
   }
 
@@ -70,6 +90,11 @@ class ScheduledQueue {
   void ReleaseCredit(int64_t bytes) {
     std::lock_guard<std::mutex> lk(mu_);
     inflight_bytes_ -= bytes;
+    if (QueueDebug()) {
+      fprintf(stderr, "[QDEBUG] release bytes=%lld inflight=%lld "
+              "pending=%zu\n", (long long)bytes,
+              (long long)inflight_bytes_, heap_.size());
+    }
     cv_.notify_one();
   }
 

@@ -186,6 +186,9 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
           p->server_id, h, payload, payload_len,
           [this, ctx, p, base, raw_len, version, scale, flags, handle,
            t_push](Message&&) {
+            if (QueueDebug())
+              fprintf(stderr, "[QDEBUG] push_ack key=%lld\n",
+                      (long long)p->key);
             Record(p->key, "push", t_push);
             // Push acknowledged -> issue the pull for the aggregate.
             MsgHeader ph{};
@@ -199,6 +202,9 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
                 p->server_id, ph, nullptr, 0,
                 [this, ctx, p, base, raw_len, scale, handle,
                  t_pull](Message&& resp) {
+                  if (QueueDebug())
+                    fprintf(stderr, "[QDEBUG] pull_resp key=%lld\n",
+                            (long long)p->key);
                   Record(p->key, "pull", t_pull);
                   if (resp.head.flags & FLAG_COMPRESSED) {
                     // Pull-leg compression: the server re-encoded the

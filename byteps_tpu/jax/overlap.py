@@ -281,6 +281,20 @@ def make_overlapped_train_step(
         raise RuntimeError(
             "make_overlapped_train_step needs PS mode (init with "
             "DMLC_NUM_SERVER>0 / BYTEPS_PS_MODE=ps)")
+    if (jax.default_backend() == "cpu"
+            and jax.local_device_count() == 1):
+        # Verified deadlock on this configuration: io_callback_impl
+        # device_puts the tap's operands onto the single-threaded XLA:CPU
+        # client while the training program occupies that same pool, so
+        # materialising the gradient inside the callback waits forever
+        # under load (one device == one async worker thread). Two or more
+        # host devices widen the pool and the hang disappears.
+        import warnings
+        warnings.warn(
+            "overlapped PS training on a single-device CPU backend can "
+            "deadlock in XLA's callback machinery under load; set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=2 (or more) "
+            "for CPU runs", stacklevel=2)
     if wire_dtype not in ("float32", "bfloat16", "int8"):
         raise ValueError(
             f"wire_dtype must be float32|bfloat16|int8, got {wire_dtype!r}")

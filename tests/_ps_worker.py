@@ -218,6 +218,46 @@ def main() -> int:
                 np.testing.assert_allclose(
                     a, base * scale * (i + 1), rtol=1e-4, atol=1e-5)
 
+        elif mode == "slow_job":
+            # The worker idles past the old 30 s finalize grace before its
+            # first push: the fleet (scheduler + servers) must still be
+            # serving. Regression for the bounded Finalize wait that
+            # silently killed any fleet whose job outlived 30 s.
+            import time as _t
+            _t.sleep(35)
+            n = 4096
+            tid = w.declare("late", n, "float32", compression="")
+            arr = np.full(n, float(rank + 1), np.float32)
+            h = w.push_pull(tid, arr, average=False)
+            w.wait(h)
+            expect = sum(r + 1 for r in range(nw))
+            np.testing.assert_allclose(arr, expect)
+
+        elif mode == "congested":
+            # Many MB-sized tensors with several rounds in flight over
+            # deliberately tiny kernel socket buffers: with response
+            # callbacks on the van recv threads this deadlocks (the recv
+            # thread blocks sending the chained PULL into a full socket
+            # and stops reading — both directions wedge); the key-hashed
+            # callback executor must keep the readers draining.
+            n = 1 << 18  # 1 MB per tensor
+            tids = [w.declare(f"cg{i}", n, "float32", compression="")
+                    for i in range(8)]
+            rounds = []
+            base = rng.standard_normal(n).astype(np.float32)
+            for r in range(3):
+                arrs = [np.ascontiguousarray(base * (rank + 1 + i + r))
+                        for i in range(len(tids))]
+                rounds.append(
+                    [(w.push_pull(t, a, average=False), a)
+                     for t, a in zip(tids, arrs)])
+            for r, batch in enumerate(rounds):
+                for i, (h, a) in enumerate(batch):
+                    w.wait(h)
+                    expect = sum(rr + 1 + i + r for rr in range(nw))
+                    np.testing.assert_allclose(a, base * expect,
+                                               rtol=1e-4, atol=1e-4)
+
         elif mode == "handles":
             # several in-flight handles; poll semantics
             tids = [w.declare(f"h{i}", 4096, "float32", compression="")

@@ -81,6 +81,23 @@ def test_deep_pipelining_one_tensor():
     run_topology(2, 1, WORKER, mode="deep_pipeline")
 
 
+def test_fleet_outlives_finalize_grace():
+    """A fleet must serve for the whole job, not a bounded grace window:
+    the server/scheduler entry calls shutdown() at startup, so their
+    Finalize wait IS the serving loop. Worker idles 35 s (past the old
+    30 s bound) before its first push; the push must still aggregate."""
+    run_topology(2, 1, WORKER, mode="slow_job", timeout=120.0)
+
+
+def test_no_recv_thread_send_deadlock():
+    """Sustained multi-round MB-scale traffic over tiny (64 KiB) kernel
+    socket buffers: response callbacks must run off the van recv threads
+    (key-hashed executor), else the push->pull chain's send from the recv
+    thread wedges both directions once the buffers fill."""
+    run_topology(2, 1, WORKER, mode="congested",
+                 extra={"BYTEPS_SOCKET_BUF": "65536"}, timeout=180.0)
+
+
 def test_onebit_semantics():
     run_topology(1, 1, WORKER, mode="onebit",
                  extra={"BYTEPS_FORCE_DISTRIBUTED": "1"})
