@@ -85,6 +85,11 @@ bool EnvBool(const char* name) {
 std::string DefaultCompConfig() {
   std::string type = EnvStr("BYTEPS_COMPRESSOR", "");
   if (type.empty()) return "";
+  if (type.find('=') != std::string::npos) {
+    // Full config-string form ("type=onebit;ef=vanilla") — pass through
+    // verbatim; the simple form below composes from the companion envs.
+    return type;
+  }
   std::string cfg = "type=" + type;
   int64_t k = EnvInt64("BYTEPS_COMPRESSOR_K", 0);
   if (k > 0) cfg += ";k=" + std::to_string(k);

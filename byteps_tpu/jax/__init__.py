@@ -129,14 +129,29 @@ def mesh() -> Mesh:
 # denominator, applied internally by push_pull).
 
 def rank() -> int:
-    """Index of this controller process in [0, size())."""
-    _st()
+    """Index of this controller process in [0, size()).
+
+    PS mode: the fleet-wide worker rank (DMLC_WORKER_ID order) — each
+    launcher-spawned worker is its own JAX process, so
+    ``jax.process_index()`` would be 0 everywhere and data sharding by
+    rank would silently train identical shards. Collective /
+    multi-controller mode: ``jax.process_index()``.
+    """
+    st = _st()
+    if st.ps_client is not None:
+        return st.ps_client.worker_rank()
     return jax.process_index()
 
 
 def size() -> int:
-    """Number of controller processes (use with rank() for data sharding)."""
-    _st()
+    """Number of controller processes (use with rank() for data sharding).
+
+    PS mode: the fleet's worker count; otherwise ``jax.process_count()``
+    (see rank()).
+    """
+    st = _st()
+    if st.ps_client is not None:
+        return st.ps_client.num_workers()
     return jax.process_count()
 
 
@@ -190,8 +205,9 @@ def push_pull(tree, average: bool = True, name: Optional[str] = None,
     Inside ``shard_map`` this is the hot path: hierarchical two-level
     all-reduce (SURVEY.md §3.3's REDUCE→PUSH/PULL→BROADCAST pipeline as one
     fused XLA program). Outside, arrays must carry a leading replica axis of
-    length ``size()`` (stacked per-replica values) and the same collective
-    runs under a jitted shard_map.
+    length ``device_count()`` — this process's mesh size — (stacked
+    per-chip values) and the same collective runs under a jitted
+    shard_map.
     """
     ici, dcn = _axes()
     if _inside_spmd(ici) or _inside_spmd(dcn):
@@ -228,7 +244,8 @@ def _global_push_pull(tree, average, compression):
         if leaf.ndim == 0 or leaf.shape[0] != n:
             raise ValueError(
                 "push_pull outside shard_map expects arrays stacked over a "
-                f"leading replica axis of length size()={n}; got shape "
+                "leading replica axis of length device_count()="
+                f"{n} (this process's mesh size); got shape "
                 f"{leaf.shape}. Inside a shard_map'd step, call push_pull "
                 "on the per-device gradients directly.")
 

@@ -56,6 +56,38 @@ import byteps_tpu.jax as bps
 from byteps_tpu.jax._compat import shard_map as _shard_map
 
 
+def io_callback_supported(backend: Optional[str] = None) -> bool:
+    """True iff the backend can run ``io_callback`` inside jit.
+
+    The overlap taps need host callbacks; most PJRT plugins support them
+    (CPU, standard TPU), but tunneled/remote plugins may not (observed:
+    "UNIMPLEMENTED: ... does not support host send/recv callbacks").
+    Probed once per backend and cached.
+    """
+    key = backend or jax.default_backend()
+    cached = _IO_CB_SUPPORT.get(key)
+    if cached is not None:
+        return cached
+    seen = []
+
+    @jax.jit
+    def probe(x):
+        io_callback(lambda v: seen.append(v), None, x, ordered=False)
+        return x + 1
+
+    try:
+        probe(jnp.int32(1)).block_until_ready()
+        jax.effects_barrier()
+        ok = True
+    except Exception:
+        ok = False
+    _IO_CB_SUPPORT[key] = ok
+    return ok
+
+
+_IO_CB_SUPPORT: Dict[str, bool] = {}
+
+
 class _TapState:
     """Declared shard tensors + in-flight handles for one step builder."""
 
@@ -281,6 +313,44 @@ def make_overlapped_train_step(
         raise RuntimeError(
             "make_overlapped_train_step needs PS mode (init with "
             "DMLC_NUM_SERVER>0 / BYTEPS_PS_MODE=ps)")
+    if not io_callback_supported():
+        # No host callbacks on this backend (tunneled/remote PJRT plugins;
+        # standard TPU and CPU both support them): the in-jit taps cannot
+        # fire, so fall back to the non-overlapped PS step. The C core
+        # still pipelines partitions (compression / network / summation
+        # overlap across tensors) — what is lost is only the overlap with
+        # backward compute.
+        import warnings
+        from byteps_tpu.jax.compression import Compression
+        from byteps_tpu.jax.training import make_train_step
+        warnings.warn(
+            f"backend {jax.default_backend()!r} does not support "
+            "io_callback inside jit; make_overlapped_train_step falls "
+            "back to the non-overlapped PS step (pushes start after "
+            "backward completes)", stacklevel=2)
+        if backward_passes_per_step != 1:
+            # The fallback cannot reproduce the accumulate-K contract
+            # (callers scaled their optimizer for it) — failing beats
+            # silently applying K-times-too-small updates every pass.
+            raise NotImplementedError(
+                "backward_passes_per_step > 1 requires the overlap taps, "
+                "which this backend cannot run (no io_callback); "
+                "accumulate microbatches in your own loop or use a "
+                "callback-capable backend")
+        if wire_dtype == "int8":
+            raise NotImplementedError(
+                "wire_dtype='int8' (blockwise scales) requires the "
+                "overlap taps; use 'bfloat16' on this backend")
+        if compression_config is not None:
+            warnings.warn(
+                "compression_config is not applied by the fallback step; "
+                "set BYTEPS_COMPRESSOR for the C-core default codec "
+                "instead", stacklevel=2)
+        return make_train_step(
+            loss_fn, optimizer, average=average, donate=False,
+            compression=(Compression.bf16 if wire_dtype == "bfloat16"
+                         else Compression.none),
+            ps_prefix=prefix)
     if (jax.default_backend() == "cpu"
             and jax.local_device_count() == 1):
         # Verified deadlock on this configuration: io_callback_impl

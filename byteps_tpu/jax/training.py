@@ -35,6 +35,7 @@ def make_train_step(
     average: bool = True,
     compression: Compressor = Compression.none,
     donate: bool = True,
+    ps_prefix: str = "grad",
 ):
     """Build ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
 
@@ -42,6 +43,11 @@ def make_train_step(
     carry the global batch on their leading axis; it is sharded over the
     (dcn, ici) mesh axes. Params/opt_state are replicated. The returned step
     is jitted with donated params/opt_state (in-place buffer reuse in HBM).
+
+    ``ps_prefix`` names this step's gradient tensors in the PS registry
+    (PS mode only). Two step builders in one process must use different
+    prefixes unless their gradient trees have identical shapes AND wire
+    dtypes — the C core rejects re-declaring a name with a new dtype.
     """
     mesh = mesh or bps.mesh()
     cfg = bps._st().config
@@ -50,7 +56,7 @@ def make_train_step(
 
     if cfg.use_ps:
         return _make_ps_train_step(loss_fn, optimizer, mesh, axes, average,
-                                   compression, donate)
+                                   compression, donate, ps_prefix)
 
     @partial(_shard_map, mesh=mesh,
              in_specs=(P(), P(), P(axes)),
@@ -70,7 +76,7 @@ def make_train_step(
 
 
 def _make_ps_train_step(loss_fn, optimizer, mesh, axes, average, compression,
-                        donate):
+                        donate, prefix="grad"):
     """PS-mode step: local-chip level inside jit, cross-host DCN level
     through the C++ KV client to the CPU parameter servers (SURVEY.md
     §3.3's two-level pipeline with XLA playing NCCL and the core playing
@@ -121,7 +127,7 @@ def _make_ps_train_step(loss_fn, optimizer, mesh, axes, average, compression,
     def step(params, opt_state, batch):
         loss, grads = grad_step(params, batch)
         dtypes = jax.tree_util.tree_map(lambda p: p.dtype, params)
-        grads = ps_push_pull(grads, average=average)
+        grads = ps_push_pull(grads, average=average, prefix=prefix)
         grads = jax.tree_util.tree_map(
             lambda g, d: compression.decompress(g, d), grads, dtypes)
         params, opt_state = apply_jit(params, opt_state, grads)

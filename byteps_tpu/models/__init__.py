@@ -19,6 +19,7 @@ from byteps_tpu.models.llama import (  # noqa: F401
 from byteps_tpu.models.transformer import (  # noqa: F401
     BertBase,
     BertLarge,
+    GPT2Medium,
     GPT2Small,
     TransformerEncoder,
     TransformerLM,

@@ -189,6 +189,10 @@ BertLarge = partial(TransformerEncoder, num_layers=24, d_model=1024,
                     num_heads=16, mlp_dim=4096)
 GPT2Small = partial(TransformerLM, num_layers=12, d_model=768,
                     num_heads=12, mlp_dim=3072)
+# GPT-2 Medium (~345M): the reference's gradient-compression benchmark
+# model (BASELINE.md config 3 pairs it with onebit/topk codecs).
+GPT2Medium = partial(TransformerLM, num_layers=24, d_model=1024,
+                     num_heads=16, mlp_dim=4096)
 
 
 def masked_lm_loss(logits: jax.Array, labels: jax.Array,

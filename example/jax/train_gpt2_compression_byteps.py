@@ -1,0 +1,131 @@
+"""GPT-2 training with gradient compression over the PS fleet.
+
+Reference analogue: BASELINE.md config 3 — "GPT-2 345M with onebit / topk
+gradient-compressor plugins" (the reference's example scripts double as
+its benchmark harness, SURVEY.md §2.6). The codec is the C core's,
+applied per tensor on the DCN leg (worker compresses the push, the server
+decodes, sums, and re-encodes the reply — SURVEY.md §2.2 server
+symmetry), so the measured wire bytes shrink in BOTH directions.
+
+Pick the codec with --compressor (sets BYTEPS_COMPRESSOR for this
+process; the env form is the reference's contract):
+
+    # uncompressed baseline, then onebit+EF, then topk, under bpslaunch:
+    python -m byteps_tpu.launcher --local 2 --num-servers 1 -- \
+        python example/jax/train_gpt2_compression_byteps.py \
+        --model tiny --compressor "type=onebit;ef=vanilla" --json
+
+Prints (with --json) one line with final loss, wire bytes (van
+counters: payload + framing, both legs), and steps/sec — the artifact
+the compression benchmark (BENCH_compression_r03.json) is built from.
+--model gpt2_medium is the reference's 345M configuration; tiny is the
+CI-sized variant the topology tests train.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="tiny",
+                   choices=["tiny", "gpt2_small", "gpt2_medium"])
+    p.add_argument("--seq-len", type=int, default=64)
+    p.add_argument("--batch-size", type=int, default=16,
+                   help="global batch (split across workers)")
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--compressor", default="",
+                   help='C-core codec config, e.g. "type=onebit;ef=vanilla"'
+                        ' or "type=topk;k=32". Empty = uncompressed.')
+    p.add_argument("--json", action="store_true",
+                   help="print one machine-readable result line")
+    args = p.parse_args()
+
+    # Must be in the environment before init: the C core reads its default
+    # codec config at worker start (reference: BYTEPS_COMPRESSOR_* envs).
+    if args.compressor:
+        os.environ["BYTEPS_COMPRESSOR"] = args.compressor
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import byteps_tpu.jax as bps
+    from byteps_tpu.jax.training import make_train_step, replicate, shard_batch
+    from byteps_tpu.models import GPT2Medium, GPT2Small, TransformerLM, lm_loss
+
+    bps.init()
+    rank, nworkers = bps.rank(), bps.size()
+
+    if args.model == "tiny":
+        model = TransformerLM(num_layers=2, d_model=128, num_heads=4,
+                              mlp_dim=256, vocab_size=512,
+                              max_len=max(64, args.seq_len),
+                              dtype=jnp.float32)
+    elif args.model == "gpt2_small":
+        model = GPT2Small()
+    else:
+        model = GPT2Medium()
+
+    # Fixed-seed synthetic corpus, identical on every worker; each worker
+    # then takes its interleaved row-shard (true data parallelism — the
+    # PS level averages the shards' gradients). A small vocab over
+    # repeated n-gram structure gives a steadily learnable next-token
+    # task, so "final loss parity vs uncompressed" is a meaningful check,
+    # not noise comparison.
+    rng = np.random.default_rng(7)
+    vocab = min(model.vocab_size, 512)
+    corpus = rng.integers(0, vocab // 4, (args.batch_size, args.seq_len))
+    corpus = (corpus * 3 + np.arange(args.seq_len)[None, :]) % vocab
+    toks = jnp.asarray(corpus[rank::max(1, nworkers)], jnp.int32)
+
+    params = model.init(jax.random.PRNGKey(0), toks[:1])
+    tx = optax.adam(args.lr)
+
+    def loss_fn(p_, batch):
+        return lm_loss(model.apply(p_, batch), batch)
+
+    mesh = bps.mesh()
+    step = make_train_step(loss_fn, tx, mesh, donate=False)
+    batch_parts = shard_batch(toks, mesh)
+    state = (replicate(params, mesh), replicate(tx.init(params), mesh))
+
+    client = bps._st().ps_client
+    sent0, recv0 = client.net_bytes() if client else (0, 0)
+    t0 = time.perf_counter()
+    loss = None
+    for i in range(args.steps):
+        *state, loss = step(*state, batch_parts)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+    sent1, recv1 = client.net_bytes() if client else (0, 0)
+
+    final_loss = float(np.asarray(loss))
+    result = {
+        "model": args.model,
+        "compressor": args.compressor or "none",
+        "workers": nworkers,
+        "steps": args.steps,
+        "final_loss": round(final_loss, 4),
+        "steps_per_sec": round(args.steps / elapsed, 3),
+        "wire_sent_mb": round((sent1 - sent0) / 1e6, 3),
+        "wire_recv_mb": round((recv1 - recv0) / 1e6, 3),
+    }
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(f"worker {rank}: final loss {final_loss:.4f}, "
+              f"{result['steps_per_sec']} steps/s, wire "
+              f"{result['wire_sent_mb']:.1f} MB out / "
+              f"{result['wire_recv_mb']:.1f} MB in "
+              f"({result['compressor']})")
+
+
+if __name__ == "__main__":
+    main()
