@@ -31,6 +31,26 @@ def _maybe_force_cpu() -> None:
         jax.config.update("jax_platforms", "cpu")
 
 
+def _peak_flops() -> float:
+    """Chip peak for the MFU denominator. Default: TPU v5e bf16 matmul
+    peak (197 TFLOP/s). Override with BENCH_PEAK_FLOPS for other chips."""
+    import os
+    return float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
+
+
+def _step_flops(jitted, *args) -> float:
+    """Model FLOPs per step from XLA's own cost analysis of the compiled
+    program (exact, includes fwd+bwd+optimizer; no hand-counted model
+    formulas to drift). Returns 0.0 if the backend can't report it."""
+    try:
+        cost = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(cost, list):  # older jax returns [dict]
+            cost = cost[0]
+        return float(cost.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
 def _make_timer(steps: int, warmup: int):
     """items/sec timer for step(state..., batch) -> (state..., loss).
     ``items`` is the item count the supplied batch actually carries, so no
@@ -61,10 +81,48 @@ def _make_timer(steps: int, warmup: int):
     return timed
 
 
+
+def _measure_pairs(run_plain, run_bps, repeats: int, n_dev: int):
+    """Back-to-back pairs with ALTERNATING within-pair order: if the chip
+    state trends inside a pair (thermal/frequency drift), a fixed order
+    biases every ratio the same way; alternation cancels the trend in the
+    median. Returns (best_plain, best_bps, ratios)."""
+    plain_ips = bench_ips = 0.0
+    ratios = []
+    for i in range(repeats):
+        if i % 2 == 0:
+            p = run_plain()
+            b = run_bps()
+        else:
+            b = run_bps()
+            p = run_plain()
+        plain_ips = max(plain_ips, p)
+        bench_ips = max(bench_ips, b)
+        ratios.append(b / n_dev / p)
+    return plain_ips, bench_ips, ratios
+
+
+def _emit(metric, unit, bench_ips, n_dev, ratios, args, flops, per_chip):
+    out = {
+        "metric": metric,
+        "value": round(bench_ips / n_dev, 2),
+        "unit": unit,
+        "vs_baseline": round(statistics.median(ratios), 4),
+        "pair_ratios": [round(r, 4) for r in sorted(ratios)],
+    }
+    if getattr(args, "mfu", False) and flops:
+        out["batch_per_chip"] = per_chip
+        out["tflops_per_step"] = round(flops / 1e12, 3)
+        out["mfu"] = round(
+            (bench_ips / n_dev) * (flops / per_chip) / _peak_flops(), 4)
+    print(json.dumps(out))
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=0, help="global batch "
-                   "(default: 64 per chip; bert: 8 per chip)")
+                   "(default: 256 per chip — the measured MFU knee, "
+                   "r3 sweep; bert: 32 per chip)")
     p.add_argument("--steps", type=int, default=25)
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--repeats", type=int, default=None,
@@ -81,14 +139,34 @@ def main() -> None:
     p.add_argument("--seq-len", type=int, default=128, help="bert only")
     p.add_argument("--smoke", action="store_true",
                    help="tiny shapes for a fast correctness pass")
+    p.add_argument("--mfu", action="store_true",
+                   help="add model-FLOPs-utilisation (XLA cost analysis / "
+                        "chip peak, BENCH_PEAK_FLOPS overridable) to the "
+                        "output line")
+    p.add_argument("--sweep", default="",
+                   help="comma-separated per-chip batch sizes; prints one "
+                        "JSON line per size (implies --mfu, fewer repeats)")
     args = p.parse_args()
+    if args.sweep:
+        args.mfu = True
+        if args.repeats is None:
+            args.repeats = 3
+        sizes = [int(s) for s in args.sweep.split(",")]
+        args.batch_is_per_chip = True  # sweep sizes are PER-CHIP batches
+        for b in sizes:
+            args.batch = b
+            (bench_bert if args.model == "bert" else bench_resnet)(args)
+        return
     if args.model == "bert":
         if args.repeats is None:
             args.repeats = 6
         return bench_bert(args)
     if args.repeats is None:
         args.repeats = 12
+    return bench_resnet(args)
 
+
+def bench_resnet(args) -> None:
     _maybe_force_cpu()
     import jax
     import jax.numpy as jnp
@@ -106,7 +184,11 @@ def main() -> None:
         args.steps = min(args.steps, 5)
     else:
         model_cls, img = ResNet50, args.image_size
-        batch = args.batch or 64 * n_dev
+        # 256/chip = the measured MFU knee (r3 sweep: 20.4% MFU at 64,
+        # 25.7% at 128, 27.7% at 256, with retention 0.9996 at 256).
+        batch = args.batch or 256 * n_dev
+        if args.batch and getattr(args, "batch_is_per_chip", False):
+            batch = args.batch * n_dev
 
     model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
     rng = np.random.default_rng(0)
@@ -154,6 +236,11 @@ def main() -> None:
                   tx.init(variables["params"]))
         return timed(plain_step, state2, plain_batch, per_chip)
 
+    # FLOPs for MFU before any buffer is donated or aliased below.
+    flops = _step_flops(
+        plain_step, variables["params"], variables["batch_stats"],
+        tx.init(variables["params"]), plain_batch) if args.mfu else 0.0
+
     # --- byteps_tpu path ---
     bps.init()
     mesh = bps.mesh()
@@ -179,23 +266,12 @@ def main() -> None:
     # into the comparison; instead pair the two paths back-to-back each
     # repeat (drift cancels within a pair) and report the MEDIAN pair
     # ratio, with the best framework throughput as the headline value.
-    plain_ips = bench_ips = 0.0
-    ratios = []
-    for _ in range(args.repeats):
-        p = run_plain()
-        b = run_bps()
-        plain_ips = max(plain_ips, p)
-        bench_ips = max(bench_ips, b)
-        ratios.append(b / n_dev / p)
-    vs = statistics.median(ratios)
-
-    print(json.dumps({
-        "metric": "resnet50_train_imgs_per_sec_per_chip"
-                  if not args.smoke else "resnet18_smoke_imgs_per_sec",
-        "value": round(bench_ips / n_dev, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(vs, 4),
-    }))
+    _, bench_ips, ratios = _measure_pairs(run_plain, run_bps,
+                                          args.repeats, n_dev)
+    _emit("resnet50_train_imgs_per_sec_per_chip"
+          if not args.smoke else "resnet18_smoke_imgs_per_sec",
+          "images/sec/chip", bench_ips, n_dev, ratios, args, flops,
+          per_chip)
 
 
 def bench_bert(args) -> None:
@@ -225,7 +301,11 @@ def bench_bert(args) -> None:
             raise SystemExit(
                 f"--seq-len {seq} exceeds BERT max_len={model.max_len} "
                 "(position embeddings would clamp silently)")
-        batch = args.batch or 8 * n_dev
+        # 32/chip = the measured MFU knee (r3 sweep: 27.5% MFU at 8,
+        # 44.0% at 16, 53.6% at 32).
+        batch = args.batch or 32 * n_dev
+        if args.batch and getattr(args, "batch_is_per_chip", False):
+            batch = args.batch * n_dev
 
     rng = np.random.default_rng(0)
     toks = jnp.asarray(rng.integers(0, 1000, (batch, seq)), jnp.int32)
@@ -258,32 +338,29 @@ def bench_bert(args) -> None:
     bps_step = make_train_step(loss_fn, tx, mesh, donate=False)
     batch_parts = shard_batch((toks, mask), mesh)
 
-    # Back-to-back pairs each repeat; median pair ratio (drift cancels
-    # within a pair — see the resnet path's comment).
-    plain_ips = bench_ips = 0.0
-    ratios = []
     host_params = jax.tree_util.tree_map(np.asarray, params)
-    for _ in range(args.repeats):
-        p = timed(
+    # FLOPs for MFU before any buffer is donated or aliased below.
+    flops = _step_flops(plain_step, params, tx.init(params),
+                        plain_batch) if getattr(args, "mfu", False) else 0.0
+
+    def run_plain():
+        return timed(
             plain_step,
             (jax.tree_util.tree_map(jnp.array, host_params),
              tx.init(params)), plain_batch, per_chip)
-        b = timed(
+
+    def run_bps():
+        return timed(
             bps_step, (replicate(host_params, mesh),
                        replicate(tx.init(params), mesh)),
             batch_parts, batch)
-        plain_ips = max(plain_ips, p)
-        bench_ips = max(bench_ips, b)
-        ratios.append(b / n_dev / p)
-    vs = statistics.median(ratios)
 
-    print(json.dumps({
-        "metric": "bert_large_mlm_seqs_per_sec_per_chip"
-                  if not args.smoke else "bert_smoke_seqs_per_sec",
-        "value": round(bench_ips / n_dev, 2),
-        "unit": "sequences/sec/chip",
-        "vs_baseline": round(vs, 4),
-    }))
+    _, bench_ips, ratios = _measure_pairs(run_plain, run_bps,
+                                          args.repeats, n_dev)
+    _emit("bert_large_mlm_seqs_per_sec_per_chip"
+          if not args.smoke else "bert_smoke_seqs_per_sec",
+          "sequences/sec/chip", bench_ips, n_dev, ratios, args, flops,
+          per_chip)
 
 
 if __name__ == "__main__":

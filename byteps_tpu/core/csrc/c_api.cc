@@ -144,6 +144,11 @@ int bps_init(int role) {
       gl->kv->OnResponse(std::move(m));
     };
     gl->po->SetShutdownCallback([gl] { gl->kv->FailAllPending(); });
+    gl->po->SetPeerLostCallback([gl](int node_id) {
+      gl->kv->FailNode(node_id, "connection to node " +
+                                    std::to_string(node_id) +
+                                    " lost (peer died or was killed)");
+    });
   }
 
   int id = gl->po->Start(gl->role, uri, port, nw, ns, std::move(handler));
@@ -193,8 +198,16 @@ int bps_broadcast(long long tensor_id, void* ptr, long long nelem, int dtype,
   return g()->worker->Broadcast(tensor_id, ptr, nelem, dtype, root);
 }
 
-void bps_wait(int handle) { g()->worker->Wait(handle); }
+// 0 = success; -1 = the handle failed fast (dead peer) — fetch the
+// diagnostic with bps_last_error().
+int bps_wait(int handle) { return g()->worker->Wait(handle); }
 int bps_poll(int handle) { return g()->worker->Poll(handle) ? 1 : 0; }
+
+const char* bps_last_error() {
+  static thread_local std::string err;
+  err = g()->worker ? g()->worker->LastError() : "";
+  return err.c_str();
+}
 
 // Dump accumulated trace events as Chrome trace-event JSON (reference:
 // BYTEPS_TRACE_ON timeline, SURVEY.md §5). Returns number of events.

@@ -65,6 +65,8 @@ enum Command : int32_t {
   CMD_SHUTDOWN = 12,     // scheduler -> all (graceful teardown)
   CMD_BCAST_PUSH = 13,   // worker -> server: root pushes initial value
   CMD_BCAST_PULL = 14,   // worker -> server: non-root pulls initial value
+  CMD_ERROR = 15,        // local synthetic: request failed (dead peer);
+                         // payload = human-readable diagnostic
 };
 
 // --- message flags ----------------------------------------------------------

@@ -30,6 +30,27 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
   app_handler_ = std::move(app_handler);
   van_ = std::make_unique<Van>(
       [this](Message&& m, int fd) { ControlHandler(std::move(m), fd); });
+  van_->SetDisconnectHandler([this](int fd) {
+    if (shutting_down_.load() || !peer_lost_cb_) return;
+    int node_id = -1;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (const auto& kv : node_fd_) {
+        if (kv.second == fd) { node_id = kv.first; break; }
+      }
+      if (node_id < 0) {
+        // A lost STRIPE also means the peer is gone (one process owns
+        // every stripe of a connection pair).
+        for (const auto& kv : node_extra_fds_) {
+          for (int efd : kv.second) {
+            if (efd == fd) { node_id = kv.first; break; }
+          }
+          if (node_id >= 0) break;
+        }
+      }
+    }
+    if (node_id >= 0) peer_lost_cb_(node_id);
+  });
 
   if (role == ROLE_SCHEDULER) {
     my_id_ = kSchedulerId;
@@ -66,17 +87,32 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
     lk.unlock();
     if (role == ROLE_WORKER) {
       // Dial every server; identify ourselves on each connection.
+      // BYTEPS_VAN_STREAMS > 1 opens extra striped connections per server
+      // (the RDMA-van role: one TCP stream's cwnd/ack clocking caps
+      // per-peer goodput; partition-keyed striping multiplies it while
+      // keeping each key's ordering on one stream).
+      int streams = 1;
+      if (const char* sv = getenv("BYTEPS_VAN_STREAMS")) {
+        streams = atoi(sv);
+        if (streams < 1) streams = 1;
+      }
       for (const auto& n : nodes_) {
         if (n.role != ROLE_SERVER) continue;
-        int sfd = van_->Connect(n.host, n.port);
-        BPS_CHECK_GE(sfd, 0) << "cannot reach server " << n.id;
-        MsgHeader hello{};
-        hello.cmd = CMD_REGISTER;
-        hello.sender = my_id_;
-        hello.arg1 = ROLE_WORKER;
-        van_->Send(sfd, hello);
-        std::lock_guard<std::mutex> lk2(mu_);
-        node_fd_[n.id] = sfd;
+        for (int s = 0; s < streams; ++s) {
+          int sfd = van_->Connect(n.host, n.port);
+          BPS_CHECK_GE(sfd, 0) << "cannot reach server " << n.id;
+          MsgHeader hello{};
+          hello.cmd = CMD_REGISTER;
+          hello.sender = my_id_;
+          hello.arg1 = ROLE_WORKER;
+          van_->Send(sfd, hello);
+          std::lock_guard<std::mutex> lk2(mu_);
+          if (s == 0) {
+            node_fd_[n.id] = sfd;
+          } else {
+            node_extra_fds_[n.id].push_back(sfd);
+          }
+        }
       }
     }
   }
@@ -287,6 +323,17 @@ int Postoffice::FdOf(int node_id) {
   auto it = node_fd_.find(node_id);
   BPS_CHECK(it != node_fd_.end()) << "no connection to node " << node_id;
   return it->second;
+}
+
+int Postoffice::FdOf(int node_id, int64_t key) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = node_fd_.find(node_id);
+  BPS_CHECK(it != node_fd_.end()) << "no connection to node " << node_id;
+  auto ex = node_extra_fds_.find(node_id);
+  if (ex == node_extra_fds_.end() || ex->second.empty()) return it->second;
+  size_t streams = ex->second.size() + 1;
+  size_t s = static_cast<size_t>(static_cast<uint64_t>(key) % streams);
+  return s == 0 ? it->second : ex->second[s - 1];
 }
 
 void Postoffice::HeartbeatLoop() {

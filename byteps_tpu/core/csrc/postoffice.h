@@ -58,6 +58,13 @@ class Postoffice {
     shutdown_cb_ = std::move(cb);
   }
 
+  // Invoked (on a van thread) when the connection to a known peer node
+  // drops while the fleet is running — the fast-fail signal for that
+  // node's in-flight requests (heartbeat timeout is the slow fallback).
+  void SetPeerLostCallback(std::function<void(int node_id)> cb) {
+    peer_lost_cb_ = std::move(cb);
+  }
+
   // --- topology queries ---
   int my_id() const { return my_id_; }
   Role role() const { return role_; }
@@ -69,6 +76,11 @@ class Postoffice {
   int my_worker_rank() const { return my_id_ - 1 - num_servers_; }
   // fd of the connection to a node (workers: scheduler + all servers).
   int FdOf(int node_id);
+  // Striped variant (BYTEPS_VAN_STREAMS): the stream for `key`, chosen by
+  // key hash so one key's traffic — and therefore its request ordering —
+  // stays on one TCP connection. Falls back to the primary fd when no
+  // extra stripes were dialed (control paths always use FdOf(node)).
+  int FdOf(int node_id, int64_t key);
 
   Van& van() { return *van_; }
   bool ShuttingDown() const { return shutting_down_.load(); }
@@ -90,7 +102,11 @@ class Postoffice {
   std::mutex mu_;
   std::condition_variable cv_;
   std::vector<NodeInfo> nodes_;            // address book (set by ADDRBOOK)
-  std::unordered_map<int, int> node_fd_;   // node id -> conn fd
+  std::unordered_map<int, int> node_fd_;   // node id -> primary conn fd
+  // node id -> extra striped data connections (BYTEPS_VAN_STREAMS > 1);
+  // worker->server only. Stripe s of key k: s = k % streams, stripe 0 =
+  // primary fd, stripe s>0 = extra[s-1].
+  std::unordered_map<int, std::vector<int>> node_extra_fds_;
   bool addrbook_ready_ = false;
 
   // scheduler state
@@ -107,6 +123,7 @@ class Postoffice {
   std::thread heartbeat_thread_;
   std::thread monitor_thread_;  // scheduler: dead-node detection
   std::function<void()> shutdown_cb_;
+  std::function<void(int)> peer_lost_cb_;
 };
 
 int64_t NowMs();

@@ -237,6 +237,9 @@ void Van::RecvLoop(int fd) {
     LogMsg("recv", fd, msg.head, static_cast<int64_t>(plen));
     handler_(std::move(msg), fd);
   }
+  // A live-van exit means the PEER went away (EOF / reset), not Stop():
+  // let the upper layer fail that peer's outstanding requests now.
+  if (!stop_.load() && disconnect_cb_) disconnect_cb_(fd);
   CloseConn(fd);
 }
 

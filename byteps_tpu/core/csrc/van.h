@@ -44,6 +44,14 @@ class Van {
   void Stop();
   bool stopped() const { return stop_.load(); }
 
+  // Invoked (on the dying connection's receive thread) when a connection
+  // closes while the van is still running — peer crash/EOF, not Stop().
+  // Upper layers use it to fail outstanding requests to that peer fast
+  // instead of waiting out the heartbeat detector.
+  void SetDisconnectHandler(std::function<void(int fd)> cb) {
+    disconnect_cb_ = std::move(cb);
+  }
+
   // Cumulative wire bytes (frames + payloads), for bandwidth assertions
   // and the timeline. Monotonic over the van's lifetime.
   int64_t bytes_sent() const { return bytes_sent_.load(); }
@@ -55,6 +63,7 @@ class Van {
   void StartRecvThread(int fd);
 
   Handler handler_;
+  std::function<void(int fd)> disconnect_cb_;
   std::atomic<int> listen_fd_{-1};
   std::atomic<bool> stop_{false};
   std::atomic<int64_t> bytes_sent_{0};

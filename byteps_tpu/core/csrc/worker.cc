@@ -129,6 +129,10 @@ int64_t BytePSWorker::Declare(const std::string& name, int64_t nelem,
   by_name_[name] = id;
   tensors_.push_back(std::move(ctx));
   lk.unlock();
+  for (int rid : reqs) {
+    BPS_CHECK_GE(rid, 0) << "declare of '" << name
+                         << "' failed: a server connection is dead";
+  }
   kv_->WaitRequests(reqs);
   return id;
 }
@@ -185,7 +189,14 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
       kv_->Request(
           p->server_id, h, payload, payload_len,
           [this, ctx, p, base, raw_len, version, scale, flags, handle,
-           t_push](Message&&) {
+           t_push](Message&& ack) {
+            if (ack.head.cmd == CMD_ERROR) {
+              // Dead server: fail the handle now with the diagnostic
+              // instead of blocking Wait until the heartbeat detector.
+              FailHandle(handle, p->key, std::move(ack));
+              queue_->ReleaseCredit(raw_len);
+              return;
+            }
             if (QueueDebug())
               fprintf(stderr, "[QDEBUG] push_ack key=%lld\n",
                       (long long)p->key);
@@ -202,6 +213,11 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
                 p->server_id, ph, nullptr, 0,
                 [this, ctx, p, base, raw_len, scale, handle,
                  t_pull](Message&& resp) {
+                  if (resp.head.cmd == CMD_ERROR) {
+                    FailHandle(handle, p->key, std::move(resp));
+                    queue_->ReleaseCredit(raw_len);
+                    return;
+                  }
                   if (QueueDebug())
                     fprintf(stderr, "[QDEBUG] pull_resp key=%lld\n",
                             (long long)p->key);
@@ -268,7 +284,11 @@ int BytePSWorker::Broadcast(int64_t tensor_id, void* ptr, int64_t nelem,
     h.key = p->key;
     h.dtype = dtype;
     h.version = bcast_version;
-    auto done = [this, base, raw_len, is_root, handle](Message&& resp) {
+    auto done = [this, p, base, raw_len, is_root, handle](Message&& resp) {
+      if (resp.head.cmd == CMD_ERROR) {
+        FailHandle(handle, p->key, std::move(resp));
+        return;
+      }
       if (!is_root) {
         BPS_CHECK_EQ(static_cast<int64_t>(resp.payload.size()), raw_len);
         memcpy(base, resp.payload.data(), raw_len);
@@ -287,23 +307,59 @@ int BytePSWorker::Broadcast(int64_t tensor_id, void* ptr, int64_t nelem,
   return handle_id;
 }
 
-void BytePSWorker::Wait(int handle_id) {
+void BytePSWorker::FailHandle(const std::shared_ptr<Handle>& handle,
+                              int64_t key, Message&& err) {
+  std::string why(err.payload.data(),
+                  err.payload.data() + err.payload.size());
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!handle->failed.load()) {
+      handle->error = "key " + std::to_string(key) + ": " + why;
+      handle->failed.store(true);
+    }
+    cv_.notify_all();
+  }
+  handle->remaining.fetch_sub(1);
+  BPS_LOG(WARNING) << "request failed for key " << key << ": " << why;
+}
+
+int BytePSWorker::Wait(int handle_id) {
   std::shared_ptr<Handle> h;
   {
     std::lock_guard<std::mutex> lk(mu_);
     auto it = handles_.find(handle_id);
-    if (it == handles_.end()) return;  // already reaped
+    if (it == handles_.end()) return 0;  // already reaped
     h = it->second;
   }
   std::unique_lock<std::mutex> lk(mu_);
+  // Even when the handle has FAILED, wait for every partition to settle
+  // (complete or fail): returning early would let still-in-flight
+  // callbacks memcpy into — and queued push tasks read from — the
+  // caller's buffer after the caller saw the error and freed it. Every
+  // partition settles promptly: live-server partitions complete, dead-
+  // server partitions get CMD_ERROR from the peer-lost scan or their
+  // send failure (each path decrements `remaining`).
   cv_.wait(lk, [&] { return h->remaining.load() == 0; });
   handles_.erase(handle_id);
+  if (h->failed.load()) {
+    last_error_ = h->error;
+    return -1;
+  }
+  return 0;
+}
+
+std::string BytePSWorker::LastError() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return last_error_;
 }
 
 bool BytePSWorker::Poll(int handle_id) {
   std::lock_guard<std::mutex> lk(mu_);
   auto it = handles_.find(handle_id);
   if (it == handles_.end()) return true;
+  // Failed = complete, but NOT reaped: the follow-up Wait must still
+  // find the handle to surface the error to the caller.
+  if (it->second->failed.load()) return true;
   if (it->second->remaining.load() != 0) return false;
   // Reap on completion so poll-only consumers don't leak handle entries.
   handles_.erase(it);

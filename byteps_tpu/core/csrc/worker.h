@@ -60,8 +60,13 @@ class BytePSWorker {
   int Broadcast(int64_t tensor_id, void* ptr, int64_t nelem, int dtype,
                 int root_rank);
 
-  void Wait(int handle);
+  // Returns 0 on success, -1 if the handle failed (dead peer) — the
+  // diagnostic is then available via LastError().
+  int Wait(int handle);
   bool Poll(int handle);
+
+  // Diagnostic for the most recent failed Wait on this worker.
+  std::string LastError();
 
   std::vector<TraceEvent> DrainTrace();
 
@@ -88,11 +93,16 @@ class BytePSWorker {
 
   struct Handle {
     std::atomic<int> remaining;
+    std::atomic<bool> failed{false};
+    std::string error;  // guarded by the worker mutex
     explicit Handle(int n) : remaining(n) {}
   };
 
   void PushLoop();
   void Record(int64_t key, const char* stage, int64_t start_us);
+  // Mark a handle failed with the CMD_ERROR diagnostic and complete it.
+  void FailHandle(const std::shared_ptr<Handle>& handle, int64_t key,
+                  Message&& err);
 
   Postoffice* po_ = nullptr;
   KVWorker* kv_ = nullptr;
@@ -106,6 +116,7 @@ class BytePSWorker {
   std::vector<std::unique_ptr<TensorCtx>> tensors_;
   std::unordered_map<int, std::shared_ptr<Handle>> handles_;
   int next_handle_ = 0;
+  std::string last_error_;  // guarded by mu_
 
   std::unique_ptr<ScheduledQueue> queue_;
   std::thread push_thread_;

@@ -69,6 +69,8 @@ def _load() -> ctypes.CDLL:
                                   ctypes.c_int]
     lib.bps_broadcast.restype = ctypes.c_int
     lib.bps_wait.argtypes = [ctypes.c_int]
+    lib.bps_wait.restype = ctypes.c_int
+    lib.bps_last_error.restype = ctypes.c_char_p
     lib.bps_poll.argtypes = [ctypes.c_int]
     lib.bps_poll.restype = ctypes.c_int
     lib.bps_dump_trace.argtypes = [ctypes.c_char_p]
@@ -195,7 +197,14 @@ class Worker(_Node):
             _DTYPE_MAP[arr.dtype.name], root_rank))
 
     def wait(self, handle: int) -> None:
-        self._lib.bps_wait(handle)
+        """Block until the handle completes. Raises RuntimeError with the
+        core's diagnostic if the operation failed fast (dead peer) —
+        instead of hanging until the heartbeat detector fires."""
+        if self._lib.bps_wait(handle) != 0:
+            err = self._lib.bps_last_error()
+            raise RuntimeError(
+                "byteps push/pull failed: "
+                + (err.decode() if err else "unknown error"))
 
     def poll(self, handle: int) -> bool:
         return bool(self._lib.bps_poll(handle))

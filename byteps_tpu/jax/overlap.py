@@ -209,7 +209,12 @@ class _TapState:
                     "(io_callback lost or step crashed mid-backward)")
             return self.inflight.pop(key)
 
-    def collect(self, leaves, timeout: float = 120.0):
+    def collect(self, leaves, timeout: Optional[float] = None):
+        if timeout is None:
+            # A big model's first step (slow compile) plus a cold fleet can
+            # exceed any fixed bound — configurable, generous default.
+            import os
+            timeout = float(os.environ.get("BYTEPS_TAP_TIMEOUT_S", "600"))
         out = []
         for i, leaf in enumerate(leaves):
             shards = []
