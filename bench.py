@@ -156,6 +156,11 @@ def main() -> None:
         for b in sizes:
             args.batch = b
             (bench_bert if args.model == "bert" else bench_resnet)(args)
+            # Each size calls bps.init(); in PS mode a second init without
+            # a shutdown is a hard error (the C core refuses double init).
+            import byteps_tpu.jax as bps
+            if bps.initialized():
+                bps.shutdown()
         return
     if args.model == "bert":
         if args.repeats is None:

@@ -317,9 +317,14 @@ void BytePSWorker::FailHandle(const std::shared_ptr<Handle>& handle,
       handle->error = "key " + std::to_string(key) + ": " + why;
       handle->failed.store(true);
     }
+  }
+  // Same order as the completion paths: decrement FIRST, then notify —
+  // notifying before the decrement is a lost wakeup (the waiter's
+  // predicate still sees the old count and sleeps forever).
+  if (handle->remaining.fetch_sub(1) == 1) {
+    std::lock_guard<std::mutex> lk(mu_);
     cv_.notify_all();
   }
-  handle->remaining.fetch_sub(1);
   BPS_LOG(WARNING) << "request failed for key " << key << ": " << why;
 }
 
@@ -357,10 +362,16 @@ bool BytePSWorker::Poll(int handle_id) {
   std::lock_guard<std::mutex> lk(mu_);
   auto it = handles_.find(handle_id);
   if (it == handles_.end()) return true;
-  // Failed = complete, but NOT reaped: the follow-up Wait must still
-  // find the handle to surface the error to the caller.
-  if (it->second->failed.load()) return true;
+  // Failed or not, a handle is complete only when every partition has
+  // settled — returning true earlier would tell a poll-driven caller
+  // the buffer is theirs while in-flight callbacks still write into it
+  // (same invariant as Wait).
   if (it->second->remaining.load() != 0) return false;
+  if (it->second->failed.load()) {
+    // NOT reaped: the follow-up Wait must still find the handle to
+    // surface the error to the caller.
+    return true;
+  }
   // Reap on completion so poll-only consumers don't leak handle entries.
   handles_.erase(it);
   return true;

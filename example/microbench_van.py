@@ -67,6 +67,12 @@ def main() -> None:
     p.add_argument("--mb", type=int, default=4, help="partition size (MB)")
     p.add_argument("--tensors", type=int, default=16)
     p.add_argument("--rounds", type=int, default=5)
+    p.add_argument("--workers", type=int, default=1,
+                   help="worker processes (each reports its own goodput; "
+                        "per-worker goodput shrinks as workers contend "
+                        "for the servers — the scaling-model validation "
+                        "knob, docs/performance.md)")
+    p.add_argument("--servers", type=int, default=1)
     p.add_argument("--role", default="")
     args = p.parse_args()
     if args.role == "worker":
@@ -81,25 +87,39 @@ def main() -> None:
     env.update({
         "DMLC_PS_ROOT_URI": "127.0.0.1",
         "DMLC_PS_ROOT_PORT": str(port),
-        "DMLC_NUM_WORKER": "1",
-        "DMLC_NUM_SERVER": "1",
-        "DMLC_WORKER_ID": "0",
+        "DMLC_NUM_WORKER": str(args.workers),
+        "DMLC_NUM_SERVER": str(args.servers),
         "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
     })
     procs = []
-    for role in ("scheduler", "server"):
+    for role, count in (("scheduler", 1), ("server", args.servers)):
+        for _ in range(count):
+            e = dict(env)
+            e["DMLC_ROLE"] = role
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.server"], env=e))
+    workers = []
+    for r in range(args.workers):
         e = dict(env)
-        e["DMLC_ROLE"] = role
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "byteps_tpu.server"], env=e))
-    e = dict(env)
-    e["DMLC_ROLE"] = "worker"
-    rc = subprocess.call(
-        [sys.executable, os.path.abspath(__file__), "--role", "worker",
-         "--mb", str(args.mb), "--tensors", str(args.tensors),
-         "--rounds", str(args.rounds)], env=e)
+        e["DMLC_ROLE"] = "worker"
+        e["DMLC_WORKER_ID"] = str(r)
+        workers.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--role", "worker",
+             "--mb", str(args.mb), "--tensors", str(args.tensors),
+             "--rounds", str(args.rounds)], env=e))
+    rc = 0
+    for wp in workers:
+        rc |= wp.wait()
     for p_ in procs:
-        p_.wait(timeout=30)
+        # A crashed worker never says goodbye, so the fleet would wait
+        # for it forever — kill leftovers instead of leaking processes
+        # (and the port) past a failed run.
+        try:
+            p_.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p_.kill()
+            p_.wait()
+            rc |= 1
     sys.exit(rc)
 
 

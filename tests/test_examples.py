@@ -226,3 +226,20 @@ def test_gpt2_compression_e2e_under_launcher():
     # re-injects dropped mass with delay): require strong learning from
     # the ~6.2 initial loss rather than parity with the 0.09 dense loss.
     assert topk["final_loss"] < 1.2, (base, topk)
+
+
+@pytest.mark.ps
+def test_van_microbench_multiworker_topology():
+    """The scaling-forecast validation harness: --workers/--servers spawn
+    a real w x s fleet and each worker reports goodput (docs/performance.md
+    scaling section is built from these numbers)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(EX, "microbench_van.py"),
+         "--mb", "1", "--tensors", "4", "--rounds", "2",
+         "--workers", "2", "--servers", "2"],
+        env={**os.environ,
+             "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")},
+        capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stdout + out.stderr
+    lines = [l for l in out.stdout.splitlines() if "goodput" in l]
+    assert len(lines) == 2, out.stdout  # one JSON line per worker
