@@ -201,7 +201,7 @@ int bps_broadcast(long long tensor_id, void* ptr, long long nelem, int dtype,
 // 0 = success; -1 = the handle failed fast (dead peer) — fetch the
 // diagnostic with bps_last_error().
 int bps_wait(int handle) { return g()->worker->Wait(handle); }
-int bps_poll(int handle) { return g()->worker->Poll(handle) ? 1 : 0; }
+int bps_poll(int handle) { return g()->worker->Poll(handle); }
 
 const char* bps_last_error() {
   static thread_local std::string err;

@@ -52,12 +52,35 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
     if (node_id >= 0) peer_lost_cb_(node_id);
   });
 
+  // Fleet-formation bound: until the topology completes no job can be
+  // running, and the dead-node monitor has an empty heartbeat table
+  // (nothing registered -> it can never fire). An indefinite wait here
+  // would therefore leak the whole fleet — scheduler + servers + the
+  // bound port — forever if one worker crashes before registering.
+  // Fail loudly instead; post-formation lifetime is unbounded (the
+  // heartbeat monitor is the failure exit from then on).
+  // PS_TOPOLOGY_TIMEOUT <= 0 disables the bound (the file's <=0
+  // convention, as with PS_HEARTBEAT_INTERVAL).
+  double form_s = EnvSeconds("PS_TOPOLOGY_TIMEOUT", 600.0);
+  auto wait_formed = [&](std::unique_lock<std::mutex>& lk,
+                         const char* what) {
+    if (form_s <= 0) {
+      cv_.wait(lk, [this] { return addrbook_ready_; });
+      return;
+    }
+    BPS_CHECK(cv_.wait_for(
+        lk,
+        std::chrono::milliseconds(static_cast<long>(form_s * 1000)),
+        [this] { return addrbook_ready_; }))
+        << what << " within PS_TOPOLOGY_TIMEOUT=" << form_s
+        << "s (a node crashed before registering?)";
+  };
   if (role == ROLE_SCHEDULER) {
     my_id_ = kSchedulerId;
     van_->Listen(root_port);
     // Wait for everyone to register; ControlHandler completes the handshake.
     std::unique_lock<std::mutex> lk(mu_);
-    cv_.wait(lk, [this] { return addrbook_ready_; });
+    wait_formed(lk, "topology did not complete");
   } else {
     int listen_port = van_->Listen(0);
     int fd = van_->Connect(root_uri, root_port);
@@ -81,9 +104,9 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
     h.arg0 = wid && *wid ? atol(wid) : -1;  // preferred rank (deterministic)
     h.arg1 = role;
     van_->Send(fd, h, &me, sizeof(me));
-    // Wait for the address book.
+    // Wait for the address book (same formation bound as the scheduler).
     std::unique_lock<std::mutex> lk(mu_);
-    cv_.wait(lk, [this] { return addrbook_ready_; });
+    wait_formed(lk, "no address book");
     lk.unlock();
     if (role == ROLE_WORKER) {
       // Dial every server; identify ourselves on each connection.
@@ -210,8 +233,15 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
         }
       } else {
         // Server side: a worker identifying itself on a fresh connection.
+        // With BYTEPS_VAN_STREAMS > 1 the same worker registers each
+        // stripe; only the FIRST (primary) fd is recorded so a later
+        // stripe can't overwrite it. Invariant: server RESPONSES always
+        // go out on the fd the request arrived on (kv.h keeps per-fd
+        // reply routing), so node_fd_ here is only a fallback for any
+        // future server-initiated send keyed by node id — which must use
+        // the primary connection.
         std::lock_guard<std::mutex> lk(mu_);
-        node_fd_[msg.head.sender] = fd;
+        node_fd_.emplace(msg.head.sender, fd);  // no-op if already known
       }
       break;
     }
@@ -424,6 +454,11 @@ void Postoffice::Finalize() {
     // defence against orphaned fleet processes.
     std::unique_lock<std::mutex> lk(mu_);
     if (EnvSeconds("PS_HEARTBEAT_INTERVAL", 5.0) > 0) {
+      // Finalize is only reachable after Start() returned, i.e. after
+      // the formation bound in Start (PS_TOPOLOGY_TIMEOUT) passed and
+      // the topology completed — so from here the heartbeat monitor has
+      // nodes to watch and IS the failure exit; the serving wait itself
+      // is rightly unbounded (it is the fleet's lifetime).
       cv_.wait(lk, [this] { return shutting_down_.load(); });
     } else {
       cv_.wait_for(lk, std::chrono::seconds(30),

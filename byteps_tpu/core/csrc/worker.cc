@@ -358,23 +358,26 @@ std::string BytePSWorker::LastError() {
   return last_error_;
 }
 
-bool BytePSWorker::Poll(int handle_id) {
+int BytePSWorker::Poll(int handle_id) {
   std::lock_guard<std::mutex> lk(mu_);
   auto it = handles_.find(handle_id);
-  if (it == handles_.end()) return true;
+  if (it == handles_.end()) return 1;
   // Failed or not, a handle is complete only when every partition has
-  // settled — returning true earlier would tell a poll-driven caller
-  // the buffer is theirs while in-flight callbacks still write into it
-  // (same invariant as Wait).
-  if (it->second->remaining.load() != 0) return false;
+  // settled — reporting completion earlier would tell a poll-driven
+  // caller the buffer is theirs while in-flight callbacks still write
+  // into it (same invariant as Wait).
+  if (it->second->remaining.load() != 0) return 0;
   if (it->second->failed.load()) {
-    // NOT reaped: the follow-up Wait must still find the handle to
-    // surface the error to the caller.
-    return true;
+    // Tri-state: -1 = settled but FAILED. NOT reaped — the follow-up
+    // Wait must still find the handle to surface the error string; the
+    // FFI poll wrapper maps -1 to that Wait so poll-only consumers
+    // neither leak the entry nor mistake a dead-peer failure for
+    // success.
+    return -1;
   }
   // Reap on completion so poll-only consumers don't leak handle entries.
   handles_.erase(it);
-  return true;
+  return 1;
 }
 
 }  // namespace bps

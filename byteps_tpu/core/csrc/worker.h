@@ -63,7 +63,9 @@ class BytePSWorker {
   // Returns 0 on success, -1 if the handle failed (dead peer) — the
   // diagnostic is then available via LastError().
   int Wait(int handle);
-  bool Poll(int handle);
+  // 1 = complete (reaped), 0 = pending, -1 = settled-but-failed (not
+  // reaped; a follow-up Wait surfaces the error and reaps).
+  int Poll(int handle);
 
   // Diagnostic for the most recent failed Wait on this worker.
   std::string LastError();

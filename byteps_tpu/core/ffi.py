@@ -207,7 +207,16 @@ class Worker(_Node):
                 + (err.decode() if err else "unknown error"))
 
     def poll(self, handle: int) -> bool:
-        return bool(self._lib.bps_poll(handle))
+        """Tri-state from the core: 1 complete (reaped), 0 pending, -1
+        settled-but-failed. Failure surfaces here too: -1 delegates to
+        wait(), which reaps the handle and raises RuntimeError with the
+        core's diagnostic — a poll-only consumer neither leaks the
+        handle entry nor silently treats a dead-peer failure as
+        success."""
+        rc = int(self._lib.bps_poll(handle))
+        if rc < 0:
+            self.wait(handle)  # reaps and raises with the error string
+        return bool(rc)
 
     def dump_trace(self, path: str) -> int:
         return int(self._lib.bps_dump_trace(path.encode()))

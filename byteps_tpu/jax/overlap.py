@@ -56,6 +56,14 @@ import byteps_tpu.jax as bps
 from byteps_tpu.jax._compat import shard_map as _shard_map
 
 
+def _effects_barrier() -> None:
+    """``jax.effects_barrier`` guarded for jax versions without it — one
+    shim for every call site, so a version that drops the API degrades
+    to the cv-wait in ``collect`` instead of crashing each step."""
+    if hasattr(jax, "effects_barrier"):
+        jax.effects_barrier()
+
+
 def io_callback_supported(backend: Optional[str] = None) -> bool:
     """True iff the backend can run ``io_callback`` inside jit.
 
@@ -77,9 +85,14 @@ def io_callback_supported(backend: Optional[str] = None) -> bool:
 
     try:
         probe(jnp.int32(1)).block_until_ready()
-        jax.effects_barrier()
+        _effects_barrier()
         ok = True
-    except Exception:
+    except jax.errors.JaxRuntimeError:
+        # Only the runtime's own verdict ("UNIMPLEMENTED: ... host
+        # send/recv callbacks" and kin) means the backend lacks
+        # callbacks. Anything else (transient tracing/API errors) must
+        # propagate rather than permanently caching ok=False and
+        # silently downgrading every overlapped step to the fallback.
         ok = False
     _IO_CB_SUPPORT[key] = ok
     return ok
@@ -188,7 +201,7 @@ class _TapState:
         still-queued io_callbacks from the crashed step, so a straggler
         cannot re-pollute the fresh window right after the clear."""
         try:
-            jax.effects_barrier()
+            _effects_barrier()
         except Exception:
             pass  # a dead backend can raise here; clearing still helps
         with self.cv:
@@ -319,20 +332,21 @@ def make_overlapped_train_step(
             "make_overlapped_train_step needs PS mode (init with "
             "DMLC_NUM_SERVER>0 / BYTEPS_PS_MODE=ps)")
     if not io_callback_supported():
-        # No host callbacks on this backend (tunneled/remote PJRT plugins;
-        # standard TPU and CPU both support them): the in-jit taps cannot
-        # fire, so fall back to the non-overlapped PS step. The C core
-        # still pipelines partitions (compression / network / summation
-        # overlap across tensors) — what is lost is only the overlap with
-        # backward compute.
+        # No host callbacks on this backend (tunneled/remote PJRT
+        # plugins; standard TPU and CPU both support them): the in-jit
+        # taps cannot fire. Fall back to bucketed multi-program stepping
+        # (SURVEY §7 hard part #1's io_callback-free overlap design):
+        # per-bucket gradient programs whose D2H + PS push overlap the
+        # backward compute of later buckets, plus a bucket pipeline over
+        # the D2H / DCN / H2D legs — real overlap, not the plain step.
         import warnings
-        from byteps_tpu.jax.compression import Compression
-        from byteps_tpu.jax.training import make_train_step
+        from byteps_tpu.jax.bucketed import make_bucketed_overlap_step
         warnings.warn(
             f"backend {jax.default_backend()!r} does not support "
-            "io_callback inside jit; make_overlapped_train_step falls "
-            "back to the non-overlapped PS step (pushes start after "
-            "backward completes)", stacklevel=2)
+            "io_callback inside jit; make_overlapped_train_step uses "
+            "bucketed multi-program overlap instead of per-parameter "
+            "taps (set BYTEPS_OVERLAP_BUCKETS / BYTEPS_BUCKET_PROGRAMS "
+            "to tune)", stacklevel=2)
         if backward_passes_per_step != 1:
             # The fallback cannot reproduce the accumulate-K contract
             # (callers scaled their optimizer for it) — failing beats
@@ -346,16 +360,10 @@ def make_overlapped_train_step(
             raise NotImplementedError(
                 "wire_dtype='int8' (blockwise scales) requires the "
                 "overlap taps; use 'bfloat16' on this backend")
-        if compression_config is not None:
-            warnings.warn(
-                "compression_config is not applied by the fallback step; "
-                "set BYTEPS_COMPRESSOR for the C-core default codec "
-                "instead", stacklevel=2)
-        return make_train_step(
-            loss_fn, optimizer, average=average, donate=False,
-            compression=(Compression.bf16 if wire_dtype == "bfloat16"
-                         else Compression.none),
-            ps_prefix=prefix)
+        return make_bucketed_overlap_step(
+            loss_fn, optimizer, average=average, wire_dtype=wire_dtype,
+            compression_config=compression_config, donate=False,
+            prefix=prefix)
     if (jax.default_backend() == "cpu"
             and jax.local_device_count() == 1):
         # Verified deadlock on this configuration: io_callback_impl
@@ -404,7 +412,9 @@ def make_overlapped_train_step(
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state
 
-    apply_jit = jax.jit(apply_fn)
+    # Gradient buffers are fresh per step — donating them lets XLA write
+    # the updates in place instead of allocating a second tree.
+    apply_jit = jax.jit(apply_fn, donate_argnums=(2,))
 
     micro = [0]
 
@@ -424,14 +434,19 @@ def make_overlapped_train_step(
             # yet run, and collect's cv-wait covers runtimes where even
             # that is lazy.
             loss.block_until_ready()
-            jax.effects_barrier()
+            _effects_barrier()
             micro[0] += 1
             if micro[0] % backward_passes_per_step:
                 # accumulation pass: gradients summed host-side, nothing
                 # on the wire yet, parameters unchanged
                 return params, opt_state, loss
-            grads = jax.tree_util.tree_unflatten(treedef,
-                                                 state.collect(leaves))
+            # ONE batched H2D for the whole collected tree: passing the
+            # numpy leaves straight to apply_jit would transfer each
+            # leaf individually at dispatch (measured 0.1-0.26 s PER
+            # LEAF on tunneled PJRT) — the same per-leaf pattern the
+            # ps.py bridge batches away.
+            grads = jax.tree_util.tree_unflatten(
+                treedef, jax.device_put(state.collect(leaves)))
             params, opt_state = apply_jit(params, opt_state, grads)
             return params, opt_state, loss
         except Exception:

@@ -55,6 +55,25 @@ def _as_arrays(leaves):
             else np.asarray(l) for l in leaves]
 
 
+def _wait_all(client, staged):
+    """Settle EVERY staged handle before surfacing a failure. client.wait
+    raises on the first failed handle; bailing out of the loop there would
+    free the numpy staging buffers of the not-yet-waited handles while
+    live-server partitions are still in flight — the C core's pull
+    callbacks would then memcpy into freed memory (the same use-after-free
+    the Wait/Poll settle semantics in worker.cc prevent one layer down).
+    Collect errors, wait everything, then re-raise the first."""
+    first_err = None
+    for h, _, _ in staged:
+        try:
+            client.wait(h)
+        except Exception as e:  # noqa: BLE001 — must settle all handles
+            if first_err is None:
+                first_err = e
+    if first_err is not None:
+        raise first_err
+
+
 def _tids(client, prefix: str, leaves):
     global declare_steps
     # Shape/dtype signature in the key: a same-named tree with different
@@ -113,8 +132,7 @@ def ps_push_pull(tree, average: bool = True, prefix: str = "grad",
         h = client.push_pull(tid, arr, average=average,
                              async_mode=async_mode)
         staged.append((h, arr, leaf))
-    for h, _, _ in staged:
-        client.wait(h)
+    _wait_all(client, staged)
     # ONE batched H2D for the whole tree (mirror of the batched
     # device_get above): per-leaf jnp.asarray would pay the host-boundary
     # dispatch latency once PER LEAF — measured ~0.1-0.26 s each on
@@ -144,8 +162,7 @@ def ps_broadcast(tree, root_rank: int = 0, prefix: str = "param"):
         arr = _writable(arr)
         h = client.broadcast(tid, arr, root_rank=root_rank)
         staged.append((h, arr, leaf))
-    for h, _, _ in staged:
-        client.wait(h)
+    _wait_all(client, staged)
     devs = jax.device_put([arr for _, arr, _ in staged])  # one batched H2D
     out = [d.reshape(leaf.shape).astype(leaf.dtype)
            for d, (_, _, leaf) in zip(devs, staged)]

@@ -242,7 +242,8 @@ def push_pull_inplace_(tensor: torch.Tensor, average: bool = True,
 
 def poll(handle: Handle) -> bool:
     """True iff the handle's communication has completed (reference:
-    byteps_torch_poll)."""
+    byteps_torch_poll). Raises RuntimeError if the operation FAILED
+    (dead peer) — poll never reports a failed handle as success."""
     if handle._done or handle._core is None or _client is None:
         return True
     return bool(_client.poll(handle._core))

@@ -30,6 +30,8 @@ def main() -> int:
         return jax_overlap_accum_main()
     if mode == "jax_async":
         return jax_async_main()
+    if mode == "jax_bucketed":
+        return jax_bucketed_main()
     w = Worker.start()
     rank = w.worker_rank()
     nw = w.num_workers()
@@ -766,6 +768,89 @@ def jax_overlap_accum_main() -> int:
                                    np.asarray(expect["w"]),
                                    rtol=2e-4, atol=2e-5)
         print(f"worker {rank}: jax_overlap_accum OK")
+        return 0
+    finally:
+        bps_jax.shutdown()
+
+
+def jax_bucketed_main() -> int:
+    """Bucketed multi-program overlap (io_callback-free fallback,
+    SURVEY.md §7 hard part #1 option 2) must reproduce single-process
+    numerics: per-bucket gradient programs + the D2H/DCN/H2D bucket
+    pipeline change WHEN communication happens, never WHAT is summed."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    import byteps_tpu.jax as bps_jax
+    from byteps_tpu.jax.bucketed import make_bucketed_overlap_step
+
+    bps_jax.init()
+    try:
+        st = bps_jax._st()
+        rank = st.ps_client.worker_rank()
+        nw = st.ps_client.num_workers()
+
+        def loss_fn(params, batch):
+            x, y = batch
+            h = jnp.tanh(x @ params["w1"] + params["b1"])
+            pred = h @ params["w2"]
+            return jnp.mean((pred - y) ** 2)
+
+        prng = np.random.default_rng(5)
+        params0 = {
+            "w1": jnp.asarray(prng.standard_normal((6, 8)),
+                              jnp.float32) * 0.4,
+            "b1": jnp.zeros((8,), jnp.float32),
+            "w2": jnp.asarray(prng.standard_normal((8, 3)),
+                              jnp.float32) * 0.4,
+        }
+        tx = optax.sgd(0.1)
+        multi = os.environ.get("BPS_BUCKET_MODE", "multi") != "single"
+        wire = os.environ.get("BPS_OVERLAP_WIRE") or "float32"
+        comp = os.environ.get("BPS_OVERLAP_COMPRESSION") or None
+        step = make_bucketed_overlap_step(
+            loss_fn, tx, n_buckets=int(os.environ.get("BPS_BUCKET_N", "2")),
+            multi_program=multi, wire_dtype=wire, compression_config=comp)
+        params = jax.tree_util.tree_map(jnp.array, params0)
+        opt_state = tx.init(params)
+        per = 8
+        for _ in range(6):
+            gx = prng.standard_normal((nw * per, 6)).astype(np.float32)
+            gy = gx[:, :3] * 2.0
+            lo, hi = rank * per, (rank + 1) * per
+            params, opt_state, loss = step(params, opt_state,
+                                           (gx[lo:hi], gy[lo:hi]))
+
+        ref_prng = np.random.default_rng(5)
+        ref_prng.standard_normal((6, 8))
+        ref_prng.standard_normal((8, 3))
+
+        @jax.jit
+        def ref_step(p, s, batch):
+            _, g = jax.value_and_grad(loss_fn)(p, batch)
+            u, s = tx.update(g, s, p)
+            return optax.apply_updates(p, u), s
+
+        ref_params = jax.tree_util.tree_map(jnp.array, params0)
+        ref_state = tx.init(ref_params)
+        for _ in range(6):
+            gx = ref_prng.standard_normal((nw * per, 6)).astype(np.float32)
+            gy = gx[:, :3] * 2.0
+            ref_params, ref_state = ref_step(ref_params, ref_state,
+                                             (gx, gy))
+        if comp:
+            rtol, atol = 0.5, 0.2
+        elif wire == "bfloat16":
+            rtol, atol = 0.05, 0.02
+        else:
+            rtol, atol = 2e-4, 2e-5
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(params[k]), np.asarray(ref_params[k]),
+                rtol=rtol, atol=atol)
+        print(f"worker {rank}: jax_bucketed OK "
+              f"({'multi' if multi else 'single'}, wire={wire})")
         return 0
     finally:
         bps_jax.shutdown()

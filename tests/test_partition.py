@@ -62,3 +62,28 @@ def test_redeclare_consistent():
     assert a1 is a2
     with pytest.raises(ValueError):
         reg.declare("a", (5,), "float32")
+
+
+def test_bucket_partition_contiguous_balanced():
+    """partition_buckets (bucketed overlap): contiguous model-order
+    groups, byte-balanced, never more than n_buckets, every index once."""
+    from byteps_tpu.jax.bucketed import partition_buckets
+
+    sizes = [100] * 8
+    b = partition_buckets(sizes, 4)
+    assert b == [[0, 1], [2, 3], [4, 5], [6, 7]]
+
+    # skewed sizes: one giant leaf must not starve later buckets
+    sizes = [4096, 8, 8, 8, 8, 8, 8, 8]
+    b = partition_buckets(sizes, 4)
+    flat = [i for grp in b for i in grp]
+    assert flat == list(range(8))          # contiguous, complete
+    assert 1 <= len(b) <= 4
+    assert b[0][0] == 0 and len(b[0]) == 1  # the giant leaf stands alone
+
+    # degenerate cases
+    assert partition_buckets([5], 4) == [[0]]
+    assert partition_buckets([5, 5], 1) == [[0, 1]]
+    b = partition_buckets([1] * 3, 8)      # more buckets than leaves
+    assert [i for grp in b for i in grp] == [0, 1, 2]
+    assert len(b) <= 3

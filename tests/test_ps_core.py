@@ -303,6 +303,51 @@ def test_jax_overlap_device_wire_compression():
                  timeout=240)
 
 
+def test_jax_bucketed_overlap_matches_single_process():
+    """Bucketed MULTI-PROGRAM overlap (SURVEY.md §7 hard part #1, the
+    io_callback-free design): per-bucket gradient programs + the
+    D2H/DCN/H2D bucket pipeline reproduce single-process numerics."""
+    run_topology(2, 1, WORKER, mode="jax_bucketed",
+                 extra={"BYTEPS_PS_MODE": "ps", "XLA_FLAGS": "",
+                        "BPS_BUCKET_MODE": "multi"},
+                 timeout=240)
+
+
+def test_jax_bucketed_single_program_pipeline():
+    """Bucketed overlap, single-program variant (boundary-leg pipelining
+    only — no recompute) matches single-process numerics too."""
+    run_topology(2, 1, WORKER, mode="jax_bucketed",
+                 extra={"BYTEPS_PS_MODE": "ps", "XLA_FLAGS": "",
+                        "BPS_BUCKET_MODE": "single"},
+                 timeout=240)
+
+
+def test_jax_bucketed_multichip_bf16_wire():
+    """Bucketed overlap under a multi-chip controller with the in-jit
+    bf16 wire cast: local pmean inside each bucket program, half the
+    boundary bytes, numerics within bf16 tolerance."""
+    run_topology(2, 1, WORKER, mode="jax_bucketed",
+                 extra={"BYTEPS_PS_MODE": "ps",
+                        "XLA_FLAGS":
+                            "--xla_force_host_platform_device_count=4",
+                        "BPS_BUCKET_MODE": "multi",
+                        "BPS_OVERLAP_WIRE": "bfloat16",
+                        "BPS_BUCKET_N": "3"},
+                 timeout=240)
+
+
+def test_jax_bucketed_with_compression():
+    """Bucketed overlap composed with the C-core codec layer (topk+EF on
+    the bucketed pushes) — the codec rides per-leaf declares exactly as
+    in the tap path."""
+    run_topology(2, 1, WORKER, mode="jax_bucketed",
+                 extra={"BYTEPS_PS_MODE": "ps", "XLA_FLAGS": "",
+                        "BPS_BUCKET_MODE": "single",
+                        "BPS_OVERLAP_COMPRESSION":
+                            "type=topk;k=24;ef=vanilla"},
+                 timeout=240)
+
+
 def test_jax_overlap_gradient_accumulation():
     """backward_passes_per_step in the overlap path (reference hook
     contract): K accumulation passes communicate once and equal one
