@@ -102,12 +102,48 @@ def _measure_pairs(run_plain, run_bps, repeats: int, n_dev: int):
     return plain_ips, bench_ips, ratios
 
 
+def _trimmed_mean(xs, trim: float = 0.25) -> float:
+    """Mean of the central (1-2*trim) fraction: near-median robustness to
+    contention outliers, ~1.4x better statistical efficiency than the
+    median on the roughly-normal bulk of the pair-ratio distribution."""
+    xs = sorted(xs)
+    k = int(len(xs) * trim)
+    core = xs[k:len(xs) - k] or xs
+    return sum(core) / len(core)
+
+
+def _bootstrap_ci(xs, stat, n_boot: int = 10000, alpha: float = 0.05):
+    """Percentile bootstrap CI for ``stat`` over the pair ratios. The
+    driver's gate reads a single number; this interval says how far that
+    number can wander between identical runs — the committed noise floor
+    the retention claim rests on (at 1x1 the two programs are identical
+    XLA, so ANY deviation from 1.0 inside this interval is measurement
+    noise, not framework overhead)."""
+    import random
+    r = random.Random(0)  # deterministic artifact
+    n = len(xs)
+    stats = sorted(stat([xs[r.randrange(n)] for _ in range(n)])
+                   for _ in range(n_boot))
+    lo = stats[int(n_boot * alpha / 2)]
+    hi = stats[int(n_boot * (1 - alpha / 2))]
+    return lo, hi
+
+
 def _emit(metric, unit, bench_ips, n_dev, ratios, args, flops, per_chip):
+    tm = _trimmed_mean(ratios)
+    lo, hi = _bootstrap_ci(ratios, _trimmed_mean)
     out = {
         "metric": metric,
         "value": round(bench_ips / n_dev, 2),
         "unit": unit,
-        "vs_baseline": round(statistics.median(ratios), 4),
+        # The gate number: 25%-trimmed mean of the alternating pair
+        # ratios (robust centre, tighter than the median; the full
+        # distribution and its bootstrap CI ride along so the number is
+        # never read without its uncertainty).
+        "vs_baseline": round(tm, 4),
+        "vs_baseline_median": round(statistics.median(ratios), 4),
+        "vs_baseline_ci95": [round(lo, 4), round(hi, 4)],
+        "n_pairs": len(ratios),
         "pair_ratios": [round(r, 4) for r in sorted(ratios)],
     }
     if getattr(args, "mfu", False) and flops:
@@ -146,6 +182,12 @@ def main() -> None:
     p.add_argument("--sweep", default="",
                    help="comma-separated per-chip batch sizes; prints one "
                         "JSON line per size (implies --mfu, fewer repeats)")
+    p.add_argument("--aa", action="store_true",
+                   help="A/A control: pair the PLAIN step against itself "
+                        "with the identical methodology. The resulting "
+                        "'ratio' is 1.0 by construction, so its spread/CI "
+                        "is the measured noise floor of the gate number "
+                        "on this host — commit it next to the real run")
     args = p.parse_args()
     if args.sweep:
         args.mfu = True
@@ -167,7 +209,11 @@ def main() -> None:
             args.repeats = 6
         return bench_bert(args)
     if args.repeats is None:
-        args.repeats = 12
+        # 16 alternating pairs: r3's 12 left the median's spread at
+        # ~±1.1% (0.9778-1.0088) — wide enough for the gate to coin-flip
+        # around the true 1.0. More pairs + the trimmed-mean centre put
+        # the 95% CI well inside ±0.5% (see docs/performance.md).
+        args.repeats = 16
     return bench_resnet(args)
 
 
@@ -245,6 +291,15 @@ def bench_resnet(args) -> None:
     flops = _step_flops(
         plain_step, variables["params"], variables["batch_stats"],
         tx.init(variables["params"]), plain_batch) if args.mfu else 0.0
+
+    if getattr(args, "aa", False):
+        # A/A control: same program both sides of every pair — the
+        # spread of these "ratios" IS the methodology's noise floor.
+        _, aa_ips, ratios = _measure_pairs(run_plain, run_plain,
+                                           args.repeats, 1)
+        _emit("resnet50_aa_noise_floor", "images/sec/chip", aa_ips, 1,
+              ratios, args, flops, per_chip)
+        return
 
     # --- byteps_tpu path ---
     bps.init()
@@ -353,6 +408,13 @@ def bench_bert(args) -> None:
             plain_step,
             (jax.tree_util.tree_map(jnp.array, host_params),
              tx.init(params)), plain_batch, per_chip)
+
+    if getattr(args, "aa", False):
+        _, aa_ips, ratios = _measure_pairs(run_plain, run_plain,
+                                           args.repeats, 1)
+        _emit("bert_aa_noise_floor", "sequences/sec/chip", aa_ips, 1,
+              ratios, args, flops, per_chip)
+        return
 
     def run_bps():
         return timed(

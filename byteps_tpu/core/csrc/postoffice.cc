@@ -82,7 +82,14 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
     std::unique_lock<std::mutex> lk(mu_);
     wait_formed(lk, "topology did not complete");
   } else {
-    int listen_port = van_->Listen(0);
+    // Deployment port mapping (the DMLC_NODE_HOST analogue for ports):
+    // BYTEPS_LISTEN_PORT pins the local bind (containers with published
+    // ports), BYTEPS_ADVERTISED_PORT is what peers are told to dial
+    // (NAT / port-forward / proxy in front of this node). Defaults:
+    // ephemeral bind, advertise what we bound.
+    int want_port = 0;
+    if (const char* lp = getenv("BYTEPS_LISTEN_PORT")) want_port = atoi(lp);
+    int listen_port = van_->Listen(want_port);
     int fd = van_->Connect(root_uri, root_port);
     BPS_CHECK_GE(fd, 0) << "cannot reach scheduler at " << root_uri << ":"
                         << root_port;
@@ -97,6 +104,9 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
     snprintf(me.host, sizeof(me.host), "%s",
              host_env && *host_env ? host_env : "127.0.0.1");
     me.port = listen_port;
+    if (const char* ap = getenv("BYTEPS_ADVERTISED_PORT")) {
+      me.port = atoi(ap);
+    }
     MsgHeader h{};
     h.cmd = CMD_REGISTER;
     h.sender = -1;
@@ -362,7 +372,17 @@ int Postoffice::FdOf(int node_id, int64_t key) {
   auto ex = node_extra_fds_.find(node_id);
   if (ex == node_extra_fds_.end() || ex->second.empty()) return it->second;
   size_t streams = ex->second.size() + 1;
-  size_t s = static_cast<size_t>(static_cast<uint64_t>(key) % streams);
+  // Mix the key bits before reducing: keys are (tensor_id<<16)|part, so
+  // a bare key % streams maps EVERY single-partition tensor to stripe 0
+  // (low 16 bits all zero) and striping silently never engages —
+  // exposed by the delay-proxy BDP sweep, where N stripes measured the
+  // same goodput as one. splitmix64 finalizer; still deterministic per
+  // key, so per-key ordering stays on one connection.
+  uint64_t h = static_cast<uint64_t>(key);
+  h ^= h >> 33; h *= 0xff51afd7ed558ccdULL;
+  h ^= h >> 33; h *= 0xc4ceb9fe1a85ec53ULL;
+  h ^= h >> 33;
+  size_t s = static_cast<size_t>(h % streams);
   return s == 0 ? it->second : ex->second[s - 1];
 }
 

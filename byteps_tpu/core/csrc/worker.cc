@@ -41,12 +41,37 @@ void BytePSWorker::Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
   }
   if (credit_bytes <= 0) credit_bytes = 4 * partition_bytes;
   queue_ = std::make_unique<ScheduledQueue>(credit_bytes);
-  push_thread_ = std::thread([this] { PushLoop(); });
+  // Sender parallelism: the van's writev blocks once a connection's
+  // SNDBUF fills, and with ONE push thread a full stripe head-of-line
+  // blocks sends to every OTHER stripe/server (exposed by the BDP
+  // sweep: N stripes measured one stripe's goodput). Concurrent pops
+  // are order-safe: a key's next-round push cannot be enqueued before
+  // its previous pull completed, so two tasks for the same key never
+  // coexist, and the van's per-fd lock serialises same-connection
+  // writes. Default: match the stripe count (capped), 1 when unstriped
+  // (the single-thread wire order PS_VERBOSE users expect).
+  int push_threads = 0;
+  if (const char* pt = getenv("BYTEPS_PUSH_THREADS")) {
+    push_threads = atoi(pt);
+  }
+  if (push_threads <= 0) {
+    int streams = 1;
+    if (const char* sv = getenv("BYTEPS_VAN_STREAMS")) {
+      streams = atoi(sv);
+    }
+    push_threads = streams > 1 ? std::min(streams, 8) : 1;
+  }
+  for (int i = 0; i < push_threads; ++i) {
+    push_threads_.emplace_back([this] { PushLoop(); });
+  }
 }
 
 void BytePSWorker::Stop() {
   if (queue_) queue_->Stop();
-  if (push_thread_.joinable()) push_thread_.join();
+  for (auto& t : push_threads_) {
+    if (t.joinable()) t.join();
+  }
+  push_threads_.clear();
 }
 
 void BytePSWorker::PushLoop() {

@@ -121,7 +121,7 @@ class BytePSWorker {
   std::string last_error_;  // guarded by mu_
 
   std::unique_ptr<ScheduledQueue> queue_;
-  std::thread push_thread_;
+  std::vector<std::thread> push_threads_;
 
   std::mutex trace_mu_;
   std::vector<TraceEvent> trace_;

@@ -183,19 +183,24 @@ def make_async_train_step(
                            np.dtype(leaf.dtype).name)
             for i, leaf in enumerate(leaves0)]
 
+    from byteps_tpu.jax.ps import _wait_all, _writable
+
     def step(params, opt_state, batch):
         updates, opt_state, loss = local_update(params, opt_state, batch)
         up_leaves = jax.tree_util.tree_flatten(updates)[0]
+        # ONE batched D2H for the whole delta tree (per-leaf np.asarray
+        # pays the host-boundary dispatch latency once per leaf).
+        host = jax.device_get(up_leaves)
         staged = []
-        for tid, leaf in zip(tids, up_leaves):
-            arr = np.ascontiguousarray(np.asarray(leaf))
+        for tid, arr in zip(tids, host):
+            arr = _writable(arr)
             h = client.push_pull(tid, arr, average=False, async_mode=True)
-            staged.append((h, arr))
-        fresh = []
-        for (h, arr), leaf in zip(staged, leaves0):
-            client.wait(h)
-            fresh.append(jnp.asarray(arr).reshape(leaf.shape)
-                         .astype(leaf.dtype))
+            staged.append((h, arr, None))
+        _wait_all(client, staged)  # settle every handle before surfacing
+        # ONE batched H2D for the pulled server state (mirror of ps.py).
+        devs = jax.device_put([arr for _, arr, _ in staged])
+        fresh = [d.reshape(leaf.shape).astype(leaf.dtype)
+                 for d, leaf in zip(devs, leaves0)]
         return (jax.tree_util.tree_unflatten(treedef, fresh), opt_state,
                 loss)
 
