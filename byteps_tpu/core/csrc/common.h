@@ -67,6 +67,10 @@ enum Command : int32_t {
   CMD_BCAST_PULL = 14,   // worker -> server: non-root pulls initial value
   CMD_ERROR = 15,        // local synthetic: request failed (dead peer);
                          // payload = human-readable diagnostic
+  CMD_SHM_HELLO = 16,    // van-internal: connector offers a shared-memory
+                         // data path; payload = shm segment name, arg0 =
+                         // per-direction ring bytes. Never reaches upper
+                         // layers.
 };
 
 // --- message flags ----------------------------------------------------------

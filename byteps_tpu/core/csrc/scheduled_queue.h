@@ -60,7 +60,12 @@ class ScheduledQueue {
               (long long)inflight_bytes_, heap_.size() + 1);
     }
     heap_.push(std::move(t));
-    cv_.notify_one();
+    // notify_all: with BYTEPS_PUSH_THREADS > 1 several poppers wait on
+    // cv_; a single notify can land on a popper whose predicate stays
+    // false (budget exhausted) and be consumed without admitting work,
+    // serialising the drain to one thread. Wakeups here are rare relative
+    // to send work, so the spurious-wake cost is noise.
+    cv_.notify_all();
   }
 
   // Blocks until the top task fits the byte budget (or Stop()). A task
@@ -95,7 +100,9 @@ class ScheduledQueue {
               "pending=%zu\n", (long long)bytes,
               (long long)inflight_bytes_, heap_.size());
     }
-    cv_.notify_one();
+    // One release can free budget for MANY queued tasks; wake every
+    // popper so they drain in parallel (see Push).
+    cv_.notify_all();
   }
 
   void Stop() {

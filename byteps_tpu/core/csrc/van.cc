@@ -1,10 +1,14 @@
 #include "van.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
+#include <ifaddrs.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/uio.h>
 #include <unistd.h>
 
@@ -12,8 +16,10 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <new>
 
 #include "logging.h"
+#include "shm_ring.h"
 
 namespace bps {
 
@@ -52,6 +58,92 @@ static void SizeSocketBuffers(int fd) {
     setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &kBuf, sizeof(kBuf));
     setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &kBuf, sizeof(kBuf));
   }
+}
+
+// --- shared-memory data path (BYTEPS_VAN_TYPE=shm) --------------------------
+
+struct Van::ShmConn {
+  ShmHeader* hdr = nullptr;
+  size_t map_len = 0;
+  ShmDir* out = nullptr;  // direction this process produces into
+  ShmDir* in = nullptr;   // direction this process consumes from
+  char* out_ring = nullptr;
+  char* in_ring = nullptr;
+  uint32_t cap = 0;
+  // Connector side keeps the segment name: normally the acceptor
+  // shm_unlinks right after mapping, but if it dies (or its attach
+  // fails) before that, the named segment would outlive both processes
+  // — tmpfs memory leaked host-wide. A second unlink is ENOENT, so the
+  // connector unlinking again at teardown is always safe.
+  std::string name;
+  // The fd number has TWO user threads on an shm connection: the idle
+  // TCP recv thread (EOF watch) and the shm recv thread (which passes fd
+  // to handlers that may reply on it). ::close only when the LAST user
+  // is done — closing while the shm thread still dispatches would let
+  // the kernel reuse the number for a fresh accept and route stale
+  // replies to an unrelated peer (the fd-reuse race CloseConn's contract
+  // exists to prevent).
+  std::atomic<int> fd_users{2};
+
+  ~ShmConn() {
+    if (hdr) munmap(hdr, map_len);
+    if (!name.empty()) shm_unlink(name.c_str());
+  }
+};
+
+static bool ShmEnabled() {
+  static const bool on = [] {
+    const char* v = getenv("BYTEPS_VAN_TYPE");
+    return v && strcmp(v, "shm") == 0;
+  }();
+  return on;
+}
+
+static uint32_t ShmRingBytes() {
+  static const uint32_t n = [] {
+    const char* v = getenv("BYTEPS_SHM_RING_BYTES");
+    long b = v ? atol(v) : 4 << 20;  // one 4 MB partition per direction;
+                                     // larger frames stream through
+    if (b < 1 << 16) b = 1 << 16;
+    if (b > 1 << 30) b = 1 << 30;
+    // Round up to a power of two: the ring's free-running uint32 indices
+    // are correct across counter wraparound only when the capacity
+    // divides 2^32 (offset = index mod cap must stay continuous as the
+    // index wraps).
+    uint32_t cap = 1u << 16;
+    while (cap < static_cast<uint32_t>(b)) cap <<= 1;
+    return cap;
+  }();
+  return n;
+}
+
+// The shm path only makes sense when the peer shares this host's memory.
+// Decided on the RESOLVED dial address: anything in 127/8 plus any
+// address bound to a local interface — so a co-located worker/server
+// pair that advertises its reachable address (DMLC_NODE_HOST=10.0.0.5
+// in a mixed fleet) still gets the ring, and remote peers keep TCP with
+// no configuration.
+static bool IsLocalAddr(const sockaddr* sa) {
+  static const std::vector<uint32_t> locals = [] {
+    std::vector<uint32_t> v;
+    ifaddrs* ifa = nullptr;
+    if (getifaddrs(&ifa) == 0) {
+      for (ifaddrs* p = ifa; p; p = p->ifa_next) {
+        if (p->ifa_addr && p->ifa_addr->sa_family == AF_INET)
+          v.push_back(reinterpret_cast<sockaddr_in*>(p->ifa_addr)
+                          ->sin_addr.s_addr);
+      }
+      freeifaddrs(ifa);
+    }
+    return v;
+  }();
+  if (sa->sa_family != AF_INET) return false;
+  uint32_t a = reinterpret_cast<const sockaddr_in*>(sa)->sin_addr.s_addr;
+  if ((ntohl(a) >> 24) == 127) return true;  // whole loopback block
+  for (uint32_t l : locals) {
+    if (l == a) return true;
+  }
+  return false;
 }
 
 static bool SendAll(int fd, const void* buf, size_t len) {
@@ -123,11 +215,15 @@ int Van::Connect(const std::string& host, int port) {
     }
     int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
     if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+      bool same_host = ShmEnabled() && IsLocalAddr(res->ai_addr);
       freeaddrinfo(res);
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       SizeSocketBuffers(fd);
+      // send_mu_ entry + TCP recv thread first: the shm recv loop may
+      // dispatch a handler that replies on this fd immediately.
       StartRecvThread(fd);
+      if (same_host) OfferShm(fd);
       return fd;
     }
     if (fd >= 0) ::close(fd);
@@ -146,16 +242,33 @@ bool Van::Send(int fd, const MsgHeader& head, const void* payload,
   h.payload_len = payload_len;
   uint64_t total = sizeof(MsgHeader) + static_cast<uint64_t>(payload_len);
   std::shared_ptr<std::mutex> smu;
+  std::shared_ptr<ShmConn> shm;
   {
     std::lock_guard<std::mutex> lk(mu_);
     auto it = send_mu_.find(fd);
     if (it == send_mu_.end()) return false;
     smu = it->second;
+    auto sit = shm_conns_.find(fd);
+    if (sit != shm_conns_.end()) shm = sit->second;
   }
   std::lock_guard<std::mutex> lk(*smu);
   // Under the per-fd send lock so the PS_VERBOSE trace order matches the
   // actual wire order (the whole point of a message trace).
   LogMsg("send", fd, h, payload_len);
+  if (shm) {
+    // Ring data path: same frame layout, memcpy instead of syscalls. The
+    // per-fd send lock makes this the ring's single producer.
+    bytes_sent_.fetch_add(
+        static_cast<int64_t>(sizeof(total) + total),
+        std::memory_order_relaxed);
+    return ShmStreamWrite(shm->out, shm->out_ring, shm->cap, &total,
+                          sizeof(total)) &&
+           ShmStreamWrite(shm->out, shm->out_ring, shm->cap, &h,
+                          sizeof(h)) &&
+           (payload_len <= 0 ||
+            ShmStreamWrite(shm->out, shm->out_ring, shm->cap, payload,
+                           static_cast<size_t>(payload_len)));
+  }
   iovec iov[3];
   iov[0].iov_base = &total;
   iov[0].iov_len = sizeof(total);
@@ -218,24 +331,48 @@ void Van::AcceptLoop() {
   if (lfd >= 0) ::close(lfd);
 }
 
+// Parse one framed message through any blocking byte-stream reader
+// (RecvAll over a socket, ShmStreamRead over a ring). Returns false on
+// EOF / connection close.
+template <typename ReadFn>
+static bool ReadFrame(ReadFn&& rd, Message* msg) {
+  uint64_t total = 0;
+  if (!rd(&total, sizeof(total))) return false;
+  BPS_CHECK_GE(total, sizeof(MsgHeader)) << "malformed frame";
+  if (!rd(&msg->head, sizeof(MsgHeader))) return false;
+  uint64_t plen = total - sizeof(MsgHeader);
+  BPS_CHECK_EQ(plen, static_cast<uint64_t>(msg->head.payload_len))
+      << "frame length mismatch";
+  if (plen > 0) {
+    msg->payload.resize_uninit(plen);  // reader overwrites every byte
+    if (!rd(msg->payload.data(), plen)) return false;
+  }
+  return true;
+}
+
+void Van::DispatchFrame(Message&& msg, int fd) {
+  int64_t plen = msg.head.payload_len;
+  bytes_recv_.fetch_add(
+      static_cast<int64_t>(sizeof(uint64_t) + sizeof(MsgHeader) + plen),
+      std::memory_order_relaxed);
+  LogMsg("recv", fd, msg.head, plen);
+  if (msg.head.cmd == CMD_SHM_HELLO) {
+    // Van-internal: the peer created a shm segment for this connection.
+    // From here on the socket carries no frames; it stays open purely
+    // as the peer-death signal (EOF in RecvLoop).
+    AttachShm(fd, msg);
+    return;
+  }
+  handler_(std::move(msg), fd);
+}
+
 void Van::RecvLoop(int fd) {
   while (!stop_.load()) {
-    uint64_t total = 0;
-    if (!RecvAll(fd, &total, sizeof(total))) break;
-    BPS_CHECK_GE(total, sizeof(MsgHeader)) << "malformed frame";
     Message msg;
-    if (!RecvAll(fd, &msg.head, sizeof(MsgHeader))) break;
-    uint64_t plen = total - sizeof(MsgHeader);
-    BPS_CHECK_EQ(plen, static_cast<uint64_t>(msg.head.payload_len))
-        << "frame length mismatch";
-    if (plen > 0) {
-      msg.payload.resize_uninit(plen);  // recv overwrites every byte
-      if (!RecvAll(fd, msg.payload.data(), plen)) break;
-    }
-    bytes_recv_.fetch_add(static_cast<int64_t>(sizeof(total) + total),
-                          std::memory_order_relaxed);
-    LogMsg("recv", fd, msg.head, static_cast<int64_t>(plen));
-    handler_(std::move(msg), fd);
+    if (!ReadFrame([fd](void* b, size_t n) { return RecvAll(fd, b, n); },
+                   &msg))
+      break;
+    DispatchFrame(std::move(msg), fd);
   }
   // A live-van exit means the PEER went away (EOF / reset), not Stop():
   // let the upper layer fail that peer's outstanding requests now.
@@ -243,12 +380,171 @@ void Van::RecvLoop(int fd) {
   CloseConn(fd);
 }
 
+// Connector side: create the segment, announce it over the socket, start
+// consuming the inbound ring. Any failure leaves the connection on plain
+// TCP (no hello sent, peer never knows).
+bool Van::OfferShm(int fd) {
+  static std::atomic<uint32_t> seq{0};
+  char name[64];
+  snprintf(name, sizeof(name), "/bpsvan_%d_%d_%u", getpid(), fd,
+           seq.fetch_add(1));
+  uint32_t cap = ShmRingBytes();
+  size_t map_len = sizeof(ShmHeader) + 2 * static_cast<size_t>(cap);
+  int sfd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (sfd < 0) {
+    BPS_LOG(WARNING) << "shm_open(" << name << ") failed: "
+                     << strerror(errno) << "; staying on TCP";
+    return false;
+  }
+  // posix_fallocate, not ftruncate: tmpfs enforces its size limit at
+  // page-fault time, so a merely-truncated segment on a small /dev/shm
+  // (Docker default: 64 MB) would SIGBUS mid-memcpy after the hello had
+  // already committed the peer to the ring. Reserving the pages up
+  // front turns overcommit into a clean stay-on-TCP fallback here.
+  int ferr = posix_fallocate(sfd, 0, static_cast<off_t>(map_len));
+  if (ferr != 0) {
+    ::close(sfd);
+    shm_unlink(name);
+    BPS_LOG(WARNING) << "shm reserve (" << map_len << " B) failed: "
+                     << strerror(ferr) << "; staying on TCP";
+    return false;
+  }
+  void* mm = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED,
+                  sfd, 0);
+  ::close(sfd);
+  if (mm == MAP_FAILED) {
+    shm_unlink(name);
+    BPS_LOG(WARNING) << "mmap shm failed: " << strerror(errno)
+                     << "; staying on TCP";
+    return false;
+  }
+  auto conn = std::make_shared<ShmConn>();
+  conn->name = name;
+  conn->hdr = new (mm) ShmHeader{};
+  conn->hdr->magic = kShmMagic;
+  conn->hdr->ring_bytes = cap;
+  conn->map_len = map_len;
+  conn->cap = cap;
+  conn->out = &conn->hdr->dir[0];  // connector produces dir 0
+  conn->in = &conn->hdr->dir[1];
+  conn->out_ring = ShmRingData(conn->hdr, 0);
+  conn->in_ring = ShmRingData(conn->hdr, 1);
+
+  MsgHeader h{};
+  h.cmd = CMD_SHM_HELLO;
+  int64_t plen = static_cast<int64_t>(strlen(name));
+  h.payload_len = plen;
+  h.arg0 = cap;
+  uint64_t total = sizeof(MsgHeader) + static_cast<uint64_t>(plen);
+  // Raw socket send: the ONLY frame this socket will ever carry. Connect
+  // has not returned the fd yet, so no concurrent Send exists.
+  if (!SendAll(fd, &total, sizeof(total)) || !SendAll(fd, &h, sizeof(h)) ||
+      !SendAll(fd, name, static_cast<size_t>(plen))) {
+    shm_unlink(name);
+    return false;  // conn dtor unmaps
+  }
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stop_.load()) return false;
+    shm_conns_[fd] = conn;
+    threads_.emplace_back([this, fd, conn] { ShmRecvLoop(fd, conn); });
+  }
+  BPS_LOG(DEBUG) << "van fd=" << fd << " data path -> shm ring " << name
+                 << " (" << cap << " B/dir)";
+  return true;
+}
+
+// Acceptor side, invoked from the connection's TCP recv thread.
+void Van::AttachShm(int fd, const Message& hello) {
+  std::string name(hello.payload.data(), hello.payload.size());
+  uint32_t cap = static_cast<uint32_t>(hello.head.arg0);
+  if (cap == 0 || (cap & (cap - 1)) != 0) {  // wrap-correctness invariant
+    BPS_LOG(WARNING) << "shm hello with invalid ring capacity " << cap
+                     << "; dropping connection";
+    ::shutdown(fd, SHUT_RDWR);
+    return;
+  }
+  size_t map_len = sizeof(ShmHeader) + 2 * static_cast<size_t>(cap);
+  int sfd = shm_open(name.c_str(), O_RDWR, 0600);
+  if (sfd < 0) {
+    // Peer committed to the ring; without it this connection is dead.
+    // Close the socket — the peer's EOF handling fails it fast.
+    BPS_LOG(WARNING) << "shm_open(" << name << ") failed: "
+                   << strerror(errno) << "; dropping connection";
+    ::shutdown(fd, SHUT_RDWR);
+    return;
+  }
+  void* mm = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED,
+                  sfd, 0);
+  ::close(sfd);
+  shm_unlink(name.c_str());  // both sides mapped or dying; name done
+  if (mm == MAP_FAILED ||
+      reinterpret_cast<ShmHeader*>(mm)->magic != kShmMagic ||
+      reinterpret_cast<ShmHeader*>(mm)->ring_bytes != cap) {
+    BPS_LOG(WARNING) << "shm map/validate failed for " << name
+                   << "; dropping connection";
+    if (mm != MAP_FAILED) munmap(mm, map_len);
+    ::shutdown(fd, SHUT_RDWR);
+    return;
+  }
+  auto conn = std::make_shared<ShmConn>();
+  conn->hdr = reinterpret_cast<ShmHeader*>(mm);
+  conn->map_len = map_len;
+  conn->cap = cap;
+  conn->out = &conn->hdr->dir[1];  // acceptor produces dir 1
+  conn->in = &conn->hdr->dir[0];
+  conn->out_ring = ShmRingData(conn->hdr, 1);
+  conn->in_ring = ShmRingData(conn->hdr, 0);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stop_.load()) return;
+    shm_conns_[fd] = conn;
+    threads_.emplace_back([this, fd, conn] { ShmRecvLoop(fd, conn); });
+  }
+  BPS_LOG(DEBUG) << "van fd=" << fd << " accepted shm ring " << name;
+}
+
+// Frame consumer for one shm connection. Mirrors RecvLoop; the TCP recv
+// thread (still blocked on the idle socket) owns disconnect
+// notification, and the fd itself closes when its last user thread
+// (this loop or the TCP recv thread via CloseConn) releases it.
+void Van::ShmRecvLoop(int fd, std::shared_ptr<ShmConn> conn) {
+  while (!stop_.load()) {
+    Message msg;
+    if (!ReadFrame(
+            [&conn](void* b, size_t n) {
+              return ShmStreamRead(conn->in, conn->in_ring, conn->cap, b,
+                                   n);
+            },
+            &msg))
+      break;
+    DispatchFrame(std::move(msg), fd);
+  }
+  if (conn->fd_users.fetch_sub(1) == 1) ::close(fd);
+}
+
 // Connection fds are CLOSED only by their owning recv thread (via
-// CloseConn at RecvLoop exit); other threads may only shutdown() them.
-// This avoids the close-vs-blocked-recv fd-reuse race.
+// CloseConn at RecvLoop exit) — for shm connections, by whichever of
+// the TCP and shm recv threads finishes LAST. Other threads may only
+// shutdown() them. This avoids the close-vs-blocked-recv (and
+// dispatch-after-close) fd-reuse races.
 void Van::CloseConn(int fd) {
-  std::lock_guard<std::mutex> lk(mu_);
-  if (send_mu_.erase(fd)) ::close(fd);
+  std::shared_ptr<ShmConn> shm;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = shm_conns_.find(fd);
+    if (it != shm_conns_.end()) {
+      shm = it->second;
+      shm_conns_.erase(it);
+    }
+    if (send_mu_.erase(fd) && !shm) ::close(fd);
+  }
+  // Outside mu_: wakes the shm recv thread (and any blocked producer in
+  // the peer process); the mapping lives until the last shared_ptr drops.
+  if (shm) {
+    ShmCloseBoth(shm->hdr);
+    if (shm->fd_users.fetch_sub(1) == 1) ::close(fd);
+  }
 }
 
 void Van::Stop() {
@@ -260,6 +556,7 @@ void Van::Stop() {
   {
     std::lock_guard<std::mutex> lk(mu_);
     for (auto& kv : send_mu_) ::shutdown(kv.first, SHUT_RDWR);
+    for (auto& kv : shm_conns_) ShmCloseBoth(kv.second->hdr);
     ts.swap(threads_);
   }
   for (auto& t : ts) {

@@ -1,4 +1,5 @@
-// Message transport over TCP sockets.
+// Message transport over TCP sockets, with an optional shared-memory data
+// path for co-located peers.
 //
 // Capability parity: reference ps-lite Van/ZMQVan (SURVEY.md §2.4) — node
 // handshake, framed message send/recv, zero-copy sends. Fresh design: no
@@ -6,6 +7,16 @@
 // connection (TPU-host fleets are Linux; thread-per-conn is simple and at
 // PS-scale [O(100) conns] well within epoll-free territory), writev-based
 // gather sends so payload bytes are never copied into a staging buffer.
+//
+// Second transport (BYTEPS_VAN_TYPE=shm): the role the reference's non-TCP
+// vans play (ZMQVan ipc:// and rdma_van.h — SURVEY.md §2.4) is "don't pay
+// the network stack when you don't have to". For loopback peers the
+// connector negotiates a per-connection POSIX shm segment over the freshly
+// dialled TCP socket (CMD_SHM_HELLO) and both sides move all subsequent
+// frames through lock-free SPSC byte rings (shm_ring.h). The TCP socket
+// stays open but idle: peer death still surfaces as an EOF on it, so
+// heartbeat-free fast-fail (SetDisconnectHandler) works identically on
+// both transports. Remote peers keep TCP — mixed fleets need no config.
 #pragma once
 
 #include <atomic>
@@ -58,9 +69,18 @@ class Van {
   int64_t bytes_recv() const { return bytes_recv_.load(); }
 
  private:
+  struct ShmConn;  // mapped segment + role (van.cc)
+
   void AcceptLoop();
   void RecvLoop(int fd);
   void StartRecvThread(int fd);
+  void ShmRecvLoop(int fd, std::shared_ptr<ShmConn> conn);
+  // Shared tail of both recv loops: wire accounting, PS_VERBOSE trace,
+  // van-internal command handling, handler dispatch — ONE copy so the
+  // transports cannot drift.
+  void DispatchFrame(Message&& msg, int fd);
+  bool OfferShm(int fd);  // connector side; returns false -> stay on TCP
+  void AttachShm(int fd, const Message& hello);  // acceptor side
 
   Handler handler_;
   std::function<void(int fd)> disconnect_cb_;
@@ -68,10 +88,14 @@ class Van {
   std::atomic<bool> stop_{false};
   std::atomic<int64_t> bytes_sent_{0};
   std::atomic<int64_t> bytes_recv_{0};
-  std::mutex mu_;  // guards send_mu_ / threads_
+  std::mutex mu_;  // guards send_mu_ / threads_ / shm_conns_
   // shared_ptr: Send() keeps the per-fd mutex alive across its write even
   // if CloseConn erases the entry concurrently (connection teardown race).
   std::unordered_map<int, std::shared_ptr<std::mutex>> send_mu_;
+  // Connections whose data path moved to a shm ring, keyed by the (still
+  // open) TCP fd. Send() consults this under the per-fd send lock, so a
+  // connection's frames never interleave across transports.
+  std::unordered_map<int, std::shared_ptr<ShmConn>> shm_conns_;
   std::vector<std::thread> threads_;
 };
 

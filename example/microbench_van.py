@@ -161,7 +161,7 @@ class DelayProxy(threading.Thread):
         """One direction of one proxied connection."""
 
         __slots__ = ("src", "dst", "q", "inflight", "sending", "eof",
-                     "closed", "reg")
+                     "closed", "reg", "want_r", "want_w")
 
         def __init__(self, src, dst):
             self.src = src          # read plaintext from here
@@ -171,7 +171,9 @@ class DelayProxy(threading.Thread):
             self.sending = None     # matured bytes partially sent
             self.eof = False
             self.closed = False
-            self.reg = False        # src registered for EVENT_READ?
+            self.reg = False        # src registered with the selector?
+            self.want_r = False     # read interest (window open, no EOF)
+            self.want_w = False     # write interest (stuck send)
 
     def run(self):
         import collections
@@ -181,6 +183,10 @@ class DelayProxy(threading.Thread):
         sel = selectors.DefaultSelector()
         sel.register(self.lsock, selectors.EVENT_READ, ("accept", None))
         dirs = []  # all _Dir objects, polled for due deliveries
+        # Each socket is one direction's read end AND the other
+        # direction's write end; selectors allow one registration per fd,
+        # so interests merge here: sock -> (read_dir, write_dir).
+        sides = {}
 
         def open_conn():
             try:
@@ -199,25 +205,52 @@ class DelayProxy(threading.Thread):
                 s.setblocking(False)
             down = self._Dir(cli, up)
             upd = self._Dir(up, cli)
+            sides[cli] = (down, upd)
+            sides[up] = (upd, down)
             for d in (down, upd):
                 d.q = collections.deque()
+                d.want_r = False
+                d.want_w = False
                 dirs.append(d)
                 set_read(d, True)
 
+        def sync_events(sock):
+            rd, wr = sides[sock]
+            mask = ((selectors.EVENT_READ if rd.want_r else 0)
+                    | (selectors.EVENT_WRITE if wr.want_w else 0))
+            registered = rd.reg
+            if mask and not registered:
+                sel.register(sock, mask, ("data", sock))
+                rd.reg = True
+            elif mask and registered:
+                sel.modify(sock, mask, ("data", sock))
+            elif not mask and registered:
+                sel.unregister(sock)
+                rd.reg = False
+
         def set_read(d, on):
-            """(Un)register d.src for readability. A full window or EOF
-            must UNREGISTER the fd: a readable-but-unconsumable socket
-            makes select() return instantly, and the loop would busy-
-            spin for the whole delay maturation period — stealing the
-            1-core host's CPU from the very processes being measured."""
+            """Interest in d.src's readability. A full window or EOF must
+            DROP the interest: a readable-but-unconsumable socket makes
+            select() return instantly, and the loop would busy-spin for
+            the whole delay maturation period — stealing the 1-core
+            host's CPU from the very processes being measured."""
             if d.eof or d.closed:
                 on = False
-            if on and not d.reg:
-                sel.register(d.src, selectors.EVENT_READ, ("data", d))
-                d.reg = True
-            elif not on and d.reg:
-                sel.unregister(d.src)
-                d.reg = False
+            if on != d.want_r:
+                d.want_r = on
+                sync_events(d.src)
+
+        def set_write(d, on):
+            """Interest in d.dst's writability — held exactly while a
+            matured chunk is stuck behind a full kernel SNDBUF
+            (d.sending after BlockingIOError). Waiting on the event
+            instead of a zero-timeout select keeps the stuck case from
+            spinning at 100% CPU."""
+            if d.closed:
+                on = False
+            if on != d.want_w:
+                d.want_w = on
+                sync_events(d.dst)
 
         def try_read(d):
             if d.eof or d.closed:
@@ -243,7 +276,8 @@ class DelayProxy(threading.Thread):
 
         def pump_out(d, now):
             """Send every matured byte this direction has; nonblocking —
-            whatever the kernel refuses is retried next loop."""
+            a chunk the kernel refuses parks behind an EVENT_WRITE
+            interest instead of a spin."""
             while not d.closed:
                 if d.sending is None:
                     if not d.q or d.q[0][0] > now:
@@ -253,12 +287,16 @@ class DelayProxy(threading.Thread):
                 try:
                     n = d.dst.send(d.sending)
                 except BlockingIOError:
+                    set_write(d, True)
                     break
                 except OSError:
                     d.closed = True
+                    set_write(d, False)  # drop a stale EVENT_WRITE
                     break
                 d.inflight -= n
                 d.sending = d.sending[n:] if n < len(d.sending) else None
+            if d.sending is None and d.want_w:
+                set_write(d, False)
             if (d.eof and not d.q and d.sending is None
                     and not d.closed):
                 try:
@@ -271,17 +309,22 @@ class DelayProxy(threading.Thread):
             now = time.perf_counter()
             timeout = 0.1
             for d in dirs:
-                if d.sending is not None or (d.q and d.q[0][0] <= now):
-                    timeout = 0.0
+                if d.q and d.q[0][0] <= now and d.sending is None:
+                    timeout = 0.0  # matured, unattempted: pump right away
                     break
-                if d.q:
+                if d.q and d.sending is None:
                     timeout = min(timeout, d.q[0][0] - now)
-            for key, _ in sel.select(timeout):
-                kind, d = key.data
+            for key, events in sel.select(timeout):
+                kind, payload = key.data
                 if kind == "accept":
                     open_conn()
-                else:
-                    try_read(d)
+                    continue
+                rd, wr = sides[payload]
+                if events & selectors.EVENT_READ:
+                    try_read(rd)
+                # EVENT_WRITE needs no handler body: the per-direction
+                # pump below retries wr.sending now that the kernel
+                # buffer has space.
             now = time.perf_counter()
             for d in dirs:
                 pump_out(d, now)
@@ -376,6 +419,44 @@ def run_streams_sweep(args) -> None:
         print(json.dumps({"artifact": args.out}))
 
 
+def run_transport_sweep(args) -> None:
+    """Goodput per van transport on one host: TCP loopback vs the shm
+    ring data path (BYTEPS_VAN_TYPE=shm — the second transport playing
+    the reference ZMQ-ipc///RDMA role for co-located peers). Same
+    workload, same fleet shape, one topology per transport."""
+    out = {"what": "van goodput by transport: identical push_pull "
+                   "workload over TCP loopback vs per-connection "
+                   "shared-memory rings (intra-host data path)",
+           "partition_mb": args.mb, "tensors": args.tensors,
+           "rounds": args.rounds, "workers": args.workers,
+           "servers": args.servers, "results": []}
+    for transport in ("tcp", "shm"):
+        rc, recs = run_once(args,
+                            extra_env={"BYTEPS_VAN_TYPE": transport},
+                            capture=True)
+        if rc != 0:
+            raise SystemExit(f"transport={transport} run failed rc={rc}")
+        for r in recs:
+            r["transport"] = transport
+        out["results"].extend(recs)
+    agg = {}
+    for r in out["results"]:
+        agg[r["transport"]] = (agg.get(r["transport"], 0.0)
+                               + r["goodput_gbit_per_s_per_leg"])
+    out["aggregate_goodput_by_transport"] = {
+        k: round(v, 3) for k, v in agg.items()}
+    if agg.get("tcp"):
+        out["shm_vs_tcp"] = round(agg.get("shm", 0.0) / agg["tcp"], 2)
+    print(json.dumps({"metric": "van_transport_sweep",
+                      "goodput_by_transport":
+                          out["aggregate_goodput_by_transport"],
+                      "shm_vs_tcp": out.get("shm_vs_tcp")}))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps({"artifact": args.out}))
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--mb", type=int, default=4, help="partition size (MB)")
@@ -397,12 +478,18 @@ def main() -> None:
     p.add_argument("--window-kb", type=int, default=512,
                    help="per-connection in-flight window of the pipe "
                         "emulator; per-stream cap = window/delay")
+    p.add_argument("--transport-sweep", action="store_true",
+                   help="run the workload over TCP loopback and the shm "
+                        "ring transport (BYTEPS_VAN_TYPE=shm) and report "
+                        "both")
     p.add_argument("--out", default="", help="write sweep JSON here")
     args = p.parse_args()
     if args.role == "worker":
         return worker_main(args)
     if args.streams_sweep:
         return run_streams_sweep(args)
+    if args.transport_sweep:
+        return run_transport_sweep(args)
     rc, _ = run_once(args)
     sys.exit(rc)
 

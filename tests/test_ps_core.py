@@ -107,6 +107,59 @@ def test_van_striped_streams():
                  extra={"BYTEPS_VAN_STREAMS": "4"}, timeout=180.0)
 
 
+def test_van_shm_transport():
+    """BYTEPS_VAN_TYPE=shm (second van transport, the reference's
+    ZMQVan-ipc:///rdma_van role — SURVEY.md §2.4): loopback connections
+    negotiate per-connection shared-memory rings over CMD_SHM_HELLO and
+    every frame moves through them. The sustained multi-round MB-scale
+    workload must aggregate exactly, as over TCP."""
+    run_topology(2, 1, WORKER, mode="congested",
+                 extra={"BYTEPS_VAN_TYPE": "shm"}, timeout=180.0)
+
+
+def test_van_shm_tiny_ring_streams_large_frames():
+    """Frames larger than the ring must stream through it like a socket
+    buffer (producer chunks, consumer drains concurrently): MB-scale
+    messages over 64 KiB rings."""
+    run_topology(2, 1, WORKER, mode="congested",
+                 extra={"BYTEPS_VAN_TYPE": "shm",
+                        "BYTEPS_SHM_RING_BYTES": "65536"}, timeout=180.0)
+
+
+def _run_dead_server_fast_fail(extra_env):
+    """Kill the only server once the worker is mid-flight; the worker's
+    peer-lost hook must fail the handle in seconds (not the 30 s
+    heartbeat detector) and the worker script reports fast-fail OK."""
+    from tests.ps_utils import free_port, spawn_role, spawn_worker, \
+        topology_env
+
+    port = free_port()
+    env = topology_env(1, 1, port, extra_env)
+    sched = spawn_role("scheduler", env)
+    server = spawn_role("server", env)
+    worker = spawn_worker(WORKER, env, 0, "fast_fail")
+    try:
+        for line in worker.stdout:
+            if line.startswith("ready"):
+                break
+        server.kill()
+        out, _ = worker.communicate(timeout=30)
+        assert worker.returncode == 0, out
+        assert "fast-fail OK" in out, out
+    finally:
+        for p in (sched, server, worker):
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+
+def test_van_shm_dead_server_fast_fail():
+    """Peer-death detection on the shm transport: the TCP socket idles
+    under the rings precisely so a killed server still surfaces as an EOF
+    — fast-fail must work unchanged (no heartbeat wait)."""
+    _run_dead_server_fast_fail({"BYTEPS_VAN_TYPE": "shm"})
+
+
 def test_onebit_semantics():
     run_topology(1, 1, WORKER, mode="onebit",
                  extra={"BYTEPS_FORCE_DISTRIBUTED": "1"})
@@ -198,29 +251,7 @@ def test_dead_server_fast_fail():
     """VERDICT r2 #9: a push into a dead connection must fail its handle
     in seconds with the server named — the worker-side peer-lost hook +
     send-failure check, not the 30 s heartbeat detector."""
-    import time
-
-    from tests.ps_utils import free_port, spawn_role, spawn_worker, \
-        topology_env
-
-    port = free_port()
-    env = topology_env(1, 1, port, None)
-    sched = spawn_role("scheduler", env)
-    server = spawn_role("server", env)
-    worker = spawn_worker(WORKER, env, 0, "fast_fail")
-    try:
-        for line in worker.stdout:
-            if line.startswith("ready"):
-                break
-        server.kill()
-        out, _ = worker.communicate(timeout=30)
-        assert worker.returncode == 0, out
-        assert "fast-fail OK" in out, out
-    finally:
-        for p in (sched, server, worker):
-            if p.poll() is None:
-                p.kill()
-                p.communicate()
+    _run_dead_server_fast_fail(None)
 
 
 def test_jax_ps_single_worker_force_distributed():
