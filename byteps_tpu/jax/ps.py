@@ -74,18 +74,56 @@ def _wait_all(client, staged):
         raise first_err
 
 
-def _tids(client, prefix: str, leaves):
+def _codec_active(st) -> bool:
+    """A fleet-default codec (BYTEPS_COMPRESSOR) is configured. Mirrors
+    the C core's rule: ANY non-empty config makes declares codec-bearing
+    (and the codecs are float32-domain — worker.cc guards the declare)."""
+    import os
+    return bool(getattr(st.config, "compressor", "")
+                or os.environ.get("BYTEPS_COMPRESSOR", ""))
+
+
+def _wire_plan(leaves, codec: bool):
+    """Per-leaf (declare dtype, compression override) so half-precision
+    wire and lossy codecs compose instead of fail-stopping:
+
+    - float32 + codec: inherit the default codec (None).
+    - bfloat16/float16 + codec: declare FLOAT32 and upcast the staged
+      host buffer — the in-jit half cast still halves the dominant
+      device<->host boundary both ways; the C codec (e.g. onebit, 32x)
+      takes the DCN leg from there.
+    - non-float leaves (int step counters in optimizer trees): declare
+      with compression="" — quantising integers is meaningless and the
+      core would reject them.
+    """
+    plan = []
+    for leaf in leaves:
+        name = np.dtype(leaf.dtype).name
+        if not codec:
+            plan.append((name, None))
+        elif name == "float32":
+            plan.append((name, None))
+        elif name in ("bfloat16", "float16"):
+            plan.append(("float32", None))
+        else:
+            plan.append((name, ""))
+    return plan
+
+
+def _tids(client, prefix: str, leaves, plan):
     global declare_steps
     # Shape/dtype signature in the key: a same-named tree with different
     # leaf sizes must re-declare (the C core rejects size changes).
-    key = (prefix, tuple((int(l.size), str(l.dtype)) for l in leaves))
+    key = (prefix, tuple((int(l.size), str(l.dtype)) for l in leaves),
+           tuple(p[0] for p in plan))
     tids = _tid_cache.get(key)
     if tids is None:
         declare_steps += 1
         tids = [
-            client.declare(f"{prefix}_{i}", int(leaf.size),
-                           np.dtype(leaf.dtype).name)
-            for i, leaf in enumerate(leaves)
+            client.declare(f"{prefix}_{i}", int(leaf.size), wire_dtype,
+                           compression=comp)
+            for i, (leaf, (wire_dtype, comp)) in enumerate(zip(leaves,
+                                                               plan))
         ]
         _tid_cache[key] = tids
     return tids
@@ -121,14 +159,17 @@ def ps_push_pull(tree, average: bool = True, prefix: str = "grad",
     if not leaves:
         return tree
     leaves = _as_arrays(leaves)
-    tids = _tids(client, prefix, leaves)
+    plan = _wire_plan(leaves, _codec_active(st))
+    tids = _tids(client, prefix, leaves, plan)
     # One batched D2H for the whole tree; each result is a fresh
     # contiguous writable host buffer that serves as both push source and
     # pull destination (no second host-side copy).
     host = jax.device_get(leaves)
     staged = []
-    for tid, arr, leaf in zip(tids, host, leaves):
+    for tid, arr, leaf, (wire_dtype, _) in zip(tids, host, leaves, plan):
         arr = _writable(arr)
+        if arr.dtype != np.dtype(wire_dtype):
+            arr = arr.astype(wire_dtype)  # half-wire + codec: f32 DCN leg
         h = client.push_pull(tid, arr, average=average,
                              async_mode=async_mode)
         staged.append((h, arr, leaf))
@@ -138,7 +179,11 @@ def ps_push_pull(tree, average: bool = True, prefix: str = "grad",
     # dispatch latency once PER LEAF — measured ~0.1-0.26 s each on
     # tunneled PJRT, i.e. tens of seconds per step for transformer-sized
     # trees. jax.device_put on the list lets the runtime overlap them.
-    devs = jax.device_put([arr for _, arr, _ in staged])
+    # Downcast upcast-staged leaves on host first so the upload leg pays
+    # half-precision bytes too (the device-side astype is then a no-op).
+    devs = jax.device_put(
+        [arr if arr.dtype == getattr(leaf, "dtype", arr.dtype)
+         else arr.astype(leaf.dtype) for _, arr, leaf in staged])
     out = [d.reshape(leaf.shape).astype(leaf.dtype)
            for d, (_, _, leaf) in zip(devs, staged)]
     return jax.tree_util.tree_unflatten(treedef, out)
@@ -155,15 +200,21 @@ def ps_broadcast(tree, root_rank: int = 0, prefix: str = "param"):
     if not leaves:
         return tree
     leaves = _as_arrays(leaves)
-    tids = _tids(client, prefix, leaves)
+    plan = _wire_plan(leaves, _codec_active(st))
+    tids = _tids(client, prefix, leaves, plan)
     host = jax.device_get(leaves)
     staged = []
-    for tid, arr, leaf in zip(tids, host, leaves):
+    for tid, arr, leaf, (wire_dtype, _) in zip(tids, host, leaves, plan):
         arr = _writable(arr)
+        if arr.dtype != np.dtype(wire_dtype):
+            arr = arr.astype(wire_dtype)
         h = client.broadcast(tid, arr, root_rank=root_rank)
         staged.append((h, arr, leaf))
     _wait_all(client, staged)
-    devs = jax.device_put([arr for _, arr, _ in staged])  # one batched H2D
+    devs = jax.device_put(
+        [arr if arr.dtype == getattr(leaf, "dtype", arr.dtype)
+         else arr.astype(leaf.dtype)
+         for _, arr, leaf in staged])  # one batched H2D
     out = [d.reshape(leaf.shape).astype(leaf.dtype)
            for d, (_, _, leaf) in zip(devs, staged)]
     return jax.tree_util.tree_unflatten(treedef, out)
