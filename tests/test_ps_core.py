@@ -457,6 +457,10 @@ def test_topology_clean_under_asan():
                  timeout=120)
     run_topology(2, 1, WORKER, mode="pull_compress", extra=extra,
                  timeout=180)
+    # shm ring transport: MB-scale sustained traffic checks every ring
+    # offset/wrap memcpy under ASan redzones.
+    run_topology(2, 1, WORKER, mode="congested",
+                 extra={**extra, "BYTEPS_VAN_TYPE": "shm"}, timeout=240)
     nsd = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "_no_shutdown_worker.py")
     run_topology(2, 1, nsd, extra=extra, timeout=120)
@@ -486,3 +490,9 @@ def test_topology_clean_under_tsan():
     run_topology(2, 1, WORKER, mode="basic", extra=extra, timeout=240)
     run_topology(2, 1, WORKER, mode="deep_pipeline", extra=extra,
                  timeout=240)
+    # shm transport: the in-process interplay (send threads vs the shm
+    # recv thread vs CloseConn/Stop teardown, fd_users refcount) is
+    # TSan-visible; the cross-process ring words themselves are not —
+    # their protocol is the seq_cst Dekker pairing in shm_ring.h.
+    run_topology(2, 1, WORKER, mode="congested",
+                 extra={**extra, "BYTEPS_VAN_TYPE": "shm"}, timeout=240)
