@@ -60,6 +60,13 @@ def main() -> None:
         os.environ["BYTEPS_COMPRESSOR"] = args.compressor
 
     import jax
+
+    # Honour JAX_PLATFORMS even when a sitecustomize registered a
+    # platform programmatically (the env var alone loses to that — same
+    # recipe as tests/conftest.py). Without this, a CPU-fleet run can
+    # silently land every worker on one tunneled TPU chip.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
     import numpy as np
     import optax
