@@ -229,6 +229,48 @@ def test_gpt2_compression_e2e_under_launcher():
 
 
 @pytest.mark.ps
+@pytest.mark.slow
+def test_half_wire_composes_with_codec_under_launcher():
+    """Regression for the config BASELINE's 345M chip bench uses: a bf16
+    wire plus a lossy fleet codec used to fail-stop at declare (codecs
+    are float32-domain). The bridge's per-leaf wire plan now declares
+    half leaves f32 and upcasts after D2H — the combined run must train
+    AND ship onebit-sized wire bytes, not bf16-sized."""
+    from tests.ps_utils import free_port
+
+    script = os.path.join(EX, "jax", "train_gpt2_compression_byteps.py")
+
+    def run(extra_cli):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["DMLC_PS_ROOT_PORT"] = str(free_port())
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run(
+            [sys.executable, "-m", "byteps_tpu.launcher", "--local", "1",
+             "--num-servers", "1", "--",
+             sys.executable, "-c", _CPU_SHIM, script,
+             "--model", "tiny", "--steps", "10", "--wire", "bf16",
+             "--json"] + extra_cli,
+            env=env, capture_output=True, text=True, timeout=420)
+        assert out.returncode == 0, out.stdout + out.stderr
+        for ln in out.stdout.splitlines():
+            if ln.strip().startswith("{") and "final_loss" in ln:
+                return json.loads(ln.strip())
+        raise AssertionError(f"no result JSON:\n{out.stdout}")
+
+    dense = run([])
+    onebit = run(["--compressor", "type=onebit;ef=vanilla"])
+    # bf16-dense wire for this model is ~2x smaller than f32; onebit on
+    # top must still cut it >8x more in each direction.
+    assert dense["wire_sent_mb"] > 8 * onebit["wire_sent_mb"], (dense,
+                                                                onebit)
+    assert dense["wire_recv_mb"] > 8 * onebit["wire_recv_mb"], (dense,
+                                                                onebit)
+    assert onebit["final_loss"] < dense["final_loss"] + 2.5, (dense,
+                                                              onebit)
+
+
+@pytest.mark.ps
 def test_van_microbench_multiworker_topology():
     """The scaling-forecast validation harness: --workers/--servers spawn
     a real w x s fleet and each worker reports goodput (docs/performance.md

@@ -87,3 +87,27 @@ def test_bucket_partition_contiguous_balanced():
     b = partition_buckets([1] * 3, 8)      # more buckets than leaves
     assert [i for grp in b for i in grp] == [0, 1, 2]
     assert len(b) <= 3
+
+
+def test_wire_plan_composes_half_precision_with_codecs():
+    """Per-leaf declare plan (jax/ps.py): with a fleet codec configured,
+    f32 leaves inherit it, half-precision leaves are declared f32 (the
+    C codecs are float32-domain; the half cast still pays on the host
+    boundary), and integer leaves disable the codec instead of being
+    quantised. Without a codec every leaf keeps its own dtype."""
+    import jax.numpy as jnp
+
+    from byteps_tpu.jax.ps import _wire_plan
+
+    leaves = [np.zeros(4, np.float32),
+              jnp.zeros(4, jnp.bfloat16),
+              np.zeros(4, np.float16),
+              np.zeros(4, np.int64)]
+    assert _wire_plan(leaves, codec=True) == [
+        ("float32", None), ("float32", None), ("float32", None),
+        ("int64", ""),
+    ]
+    assert _wire_plan(leaves, codec=False) == [
+        ("float32", None), ("bfloat16", None), ("float16", None),
+        ("int64", None),
+    ]

@@ -160,6 +160,29 @@ def test_van_shm_dead_server_fast_fail():
     _run_dead_server_fast_fail({"BYTEPS_VAN_TYPE": "shm"})
 
 
+def test_van_shm_engages_on_non_loopback_local_address():
+    """The shm decision is by RESOLVED address vs local interfaces, not
+    literal '127.0.0.1' (docs promise 'a co-located worker/server pair
+    in any deployment'): a fleet addressing itself by the host's real IP
+    (DMLC_NODE_HOST in a mixed deployment) must still negotiate rings —
+    asserted from the van's own DEBUG line, so a silent TCP fallback
+    fails the test rather than passing it."""
+    import subprocess
+
+    ip = subprocess.run(["hostname", "-I"], capture_output=True,
+                        text=True).stdout.split()
+    ip = next((a for a in ip if "." in a and not a.startswith("127.")),
+              None)
+    if ip is None:
+        pytest.skip("host has no non-loopback IPv4 address")
+    outs = run_topology(1, 1, WORKER, mode="basic",
+                        extra={"BYTEPS_VAN_TYPE": "shm",
+                               "DMLC_PS_ROOT_URI": ip,
+                               "DMLC_NODE_HOST": ip,
+                               "BYTEPS_LOG_LEVEL": "DEBUG"})
+    assert any("shm ring" in o for o in outs), outs[0][-2000:]
+
+
 def test_onebit_semantics():
     run_topology(1, 1, WORKER, mode="onebit",
                  extra={"BYTEPS_FORCE_DISTRIBUTED": "1"})
