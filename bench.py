@@ -157,22 +157,26 @@ def _emit(metric, unit, bench_ips, n_dev, ratios, args, flops, per_chip):
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=0, help="global batch "
-                   "(default: 256 per chip — the measured MFU knee, "
-                   "r3 sweep; bert: 32 per chip)")
+                   "(defaults = the measured MFU knees: resnet 256/chip, "
+                   "bert 32/chip, gpt2 8/chip)")
     p.add_argument("--steps", type=int, default=25)
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--repeats", type=int, default=None,
                    help="back-to-back measurement pairs; vs_baseline is "
-                        "the median pair ratio. 25-step windows measured "
-                        "most stable: shorter ones amplify host-dispatch "
-                        "jitter, longer ones let chip drift into the pair. "
-                        "Default: 12 (resnet) / 6 (bert — its compiles "
-                        "dominate wall time)")
+                        "the 25%%-trimmed mean of the pair ratios (CI "
+                        "rides along). 25-step windows measured most "
+                        "stable: shorter ones amplify host-dispatch "
+                        "jitter, longer ones let chip drift into the "
+                        "pair. Default: 16 (resnet) / 6 (bert, gpt2 — "
+                        "their compiles dominate wall time)")
     p.add_argument("--image-size", type=int, default=224)
-    p.add_argument("--model", choices=["resnet50", "bert"],
+    p.add_argument("--model", choices=["resnet50", "bert", "gpt2"],
                    default="resnet50",
-                   help="bert = BERT-Large MLM (BASELINE.md config 2)")
-    p.add_argument("--seq-len", type=int, default=128, help="bert only")
+                   help="bert = BERT-Large MLM (BASELINE.md config 2); "
+                        "gpt2 = GPT-2 124M causal LM (the reference's "
+                        "third benchmark family)")
+    p.add_argument("--seq-len", type=int, default=0,
+                   help="bert/gpt2 only (default: 128 bert / 512 gpt2)")
     p.add_argument("--smoke", action="store_true",
                    help="tiny shapes for a fast correctness pass")
     p.add_argument("--mfu", action="store_true",
@@ -197,7 +201,8 @@ def main() -> None:
         args.batch_is_per_chip = True  # sweep sizes are PER-CHIP batches
         for b in sizes:
             args.batch = b
-            (bench_bert if args.model == "bert" else bench_resnet)(args)
+            {"bert": bench_bert, "gpt2": bench_gpt2}.get(
+                args.model, bench_resnet)(args)
             # Each size calls bps.init(); in PS mode a second init without
             # a shutdown is a hard error (the C core refuses double init).
             import byteps_tpu.jax as bps
@@ -208,6 +213,10 @@ def main() -> None:
         if args.repeats is None:
             args.repeats = 6
         return bench_bert(args)
+    if args.model == "gpt2":
+        if args.repeats is None:
+            args.repeats = 6
+        return bench_gpt2(args)
     if args.repeats is None:
         # 16 alternating pairs: r3's 12 left the median's spread at
         # ~±1.1% (0.9778-1.0088) — wide enough for the gate to coin-flip
@@ -334,9 +343,19 @@ def bench_resnet(args) -> None:
           per_chip)
 
 
-def bench_bert(args) -> None:
-    """BERT-Large MLM training throughput (sequences/sec/chip) through the
-    full byteps_tpu step vs a plain-JAX single-chip baseline."""
+def _bench_lm(args, *, build_models, make_batch, make_loss,
+              knee_per_chip, knee_note, seq_default, metric,
+              smoke_metric, aa_metric) -> None:
+    """Shared LM benchmark harness (BERT MLM / GPT-2 causal LM):
+    sequences/sec/chip through the full byteps_tpu step vs a plain-JAX
+    single-chip baseline. One copy of the methodology — pair
+    alternation, baseline-first ordering, donate=False symmetry, host
+    snapshots, FLOPs-before-donation — so per-model wrappers cannot
+    drift from each other.
+
+    build_models(args, smoke) -> (model, seq); make_batch(rng, model,
+    batch, seq) -> batch pytree; make_loss(model) -> loss_fn(p, batch).
+    """
     _maybe_force_cpu()
     import jax
     import jax.numpy as jnp
@@ -346,41 +365,36 @@ def bench_bert(args) -> None:
     import byteps_tpu.jax as bps
     from byteps_tpu.jax.training import (make_train_step, replicate,
                                          shard_batch)
-    from byteps_tpu.models import BertBase, BertLarge, masked_lm_loss
 
     n_dev = len(jax.devices())
     if args.smoke:
-        model = BertBase(num_layers=2, d_model=64, num_heads=4, mlp_dim=128,
-                         vocab_size=1024, max_len=64, dtype=jnp.float32)
-        seq, batch = 32, max(8, n_dev)
+        model, seq = build_models(args, smoke=True)
+        batch = max(8, n_dev)
         args.steps = min(args.steps, 5)
     else:
-        model = BertLarge(dtype=jnp.bfloat16)
-        seq = args.seq_len
+        model, seq = build_models(args, smoke=False)
         if seq > model.max_len:
             raise SystemExit(
-                f"--seq-len {seq} exceeds BERT max_len={model.max_len} "
+                f"--seq-len {seq} exceeds max_len={model.max_len} "
                 "(position embeddings would clamp silently)")
-        # 32/chip = the measured MFU knee (r3 sweep: 27.5% MFU at 8,
-        # 44.0% at 16, 53.6% at 32).
-        batch = args.batch or 32 * n_dev
+        # Default = the measured MFU knee for this model (knee_note).
+        batch = args.batch or knee_per_chip * n_dev
         if args.batch and getattr(args, "batch_is_per_chip", False):
             batch = args.batch * n_dev
 
     rng = np.random.default_rng(0)
-    toks = jnp.asarray(rng.integers(0, 1000, (batch, seq)), jnp.int32)
-    mask = jnp.asarray(rng.integers(0, 2, (batch, seq)), jnp.int32)
-    params = model.init(jax.random.PRNGKey(0), toks[:1])
+    full_batch = make_batch(rng, model, batch, seq)
+    # init from the token leaf only (both LMs take tokens positionally)
+    params = model.init(jax.random.PRNGKey(0),
+                        jax.tree_util.tree_leaves(full_batch)[0][:1])
     tx = optax.adamw(1e-4)
-
-    def loss_fn(p, batch_):
-        t, m = batch_
-        return masked_lm_loss(model.apply(p, t), t, m)
+    loss_fn = make_loss(model)
 
     timed = _make_timer(args.steps, args.warmup)
 
     # plain-JAX single-chip baseline on the per-chip batch (run FIRST: the
-    # framework step donates its buffers)
+    # framework step donates its buffers on some configurations, and
+    # replicate() may alias host buffers)
     @jax.jit
     def plain_step(p, opt_state, batch_):
         loss, g = jax.value_and_grad(loss_fn)(p, batch_)
@@ -388,7 +402,10 @@ def bench_bert(args) -> None:
         return optax.apply_updates(p, u), opt_state, loss
 
     per_chip = max(1, batch // n_dev)
-    plain_batch = (jnp.array(toks[:per_chip]), jnp.array(mask[:per_chip]))
+    # Materialise the baseline slice before shard_batch touches the full
+    # batch (its device_put can invalidate the originals).
+    plain_batch = jax.tree_util.tree_map(lambda a: jnp.array(a[:per_chip]),
+                                         full_batch)
 
     bps.init()
     mesh = bps.mesh()
@@ -396,7 +413,7 @@ def bench_bert(args) -> None:
     # the DCN leg through the C++ KV client. donate=False to match the
     # non-donating plain baseline (see the resnet path's comment).
     bps_step = make_train_step(loss_fn, tx, mesh, donate=False)
-    batch_parts = shard_batch((toks, mask), mesh)
+    batch_parts = shard_batch(full_batch, mesh)
 
     host_params = jax.tree_util.tree_map(np.asarray, params)
     # FLOPs for MFU before any buffer is donated or aliased below.
@@ -412,8 +429,8 @@ def bench_bert(args) -> None:
     if getattr(args, "aa", False):
         _, aa_ips, ratios = _measure_pairs(run_plain, run_plain,
                                            args.repeats, 1)
-        _emit("bert_aa_noise_floor", "sequences/sec/chip", aa_ips, 1,
-              ratios, args, flops, per_chip)
+        _emit(aa_metric, "sequences/sec/chip", aa_ips, 1, ratios, args,
+              flops, per_chip)
         return
 
     def run_bps():
@@ -424,10 +441,79 @@ def bench_bert(args) -> None:
 
     _, bench_ips, ratios = _measure_pairs(run_plain, run_bps,
                                           args.repeats, n_dev)
-    _emit("bert_large_mlm_seqs_per_sec_per_chip"
-          if not args.smoke else "bert_smoke_seqs_per_sec",
+    _emit(metric if not args.smoke else smoke_metric,
           "sequences/sec/chip", bench_ips, n_dev, ratios, args, flops,
           per_chip)
+
+
+def bench_bert(args) -> None:
+    """BERT-Large MLM (BASELINE.md config 2). Knee: r3 sweep measured
+    27.5% MFU at batch 8/chip, 44.0% at 16, 53.6% at 32."""
+    import jax.numpy as jnp
+
+    def build_models(args, smoke):
+        from byteps_tpu.models import BertBase, BertLarge
+        if smoke:
+            return (BertBase(num_layers=2, d_model=64, num_heads=4,
+                             mlp_dim=128, vocab_size=1024, max_len=64,
+                             dtype=jnp.float32), 32)
+        return BertLarge(dtype=jnp.bfloat16), (args.seq_len or 128)
+
+    def make_batch(rng, model, batch, seq):
+        return (jnp.asarray(rng.integers(0, 1000, (batch, seq)),
+                            jnp.int32),
+                jnp.asarray(rng.integers(0, 2, (batch, seq)), jnp.int32))
+
+    def make_loss(model):
+        from byteps_tpu.models import masked_lm_loss
+
+        def loss_fn(p, batch_):
+            t, m = batch_
+            return masked_lm_loss(model.apply(p, t), t, m)
+        return loss_fn
+
+    _bench_lm(args, build_models=build_models, make_batch=make_batch,
+              make_loss=make_loss, knee_per_chip=32,
+              knee_note="r3 sweep: 27.5%/44.0%/53.6% MFU at 8/16/32",
+              seq_default=128,
+              metric="bert_large_mlm_seqs_per_sec_per_chip",
+              smoke_metric="bert_smoke_seqs_per_sec",
+              aa_metric="bert_aa_noise_floor")
+
+
+def bench_gpt2(args) -> None:
+    """GPT-2 124M causal LM (seq 512) — the reference's third benchmark
+    family (its examples train GPT-2 via torch; BASELINE config 3
+    benches this family's 345M with codecs, measured separately in
+    BENCH_compression_r04.json). Knee: r4 sweep measured 30.4% MFU at
+    batch 4/chip, 37.8% at 8, 36.2% at 16 — throughput peaks at 8 too
+    (181 vs 174 seq/s)."""
+    import jax.numpy as jnp
+
+    def build_models(args, smoke):
+        from byteps_tpu.models import GPT2Small, TransformerLM
+        if smoke:
+            return (TransformerLM(num_layers=2, d_model=64, num_heads=4,
+                                  mlp_dim=128, vocab_size=1024,
+                                  max_len=64, dtype=jnp.float32), 32)
+        return GPT2Small(), (args.seq_len or 512)
+
+    def make_batch(rng, model, batch, seq):
+        return jnp.asarray(
+            rng.integers(0, min(model.vocab_size, 50000), (batch, seq)),
+            jnp.int32)
+
+    def make_loss(model):
+        from byteps_tpu.models import lm_loss
+        return lambda p, batch_: lm_loss(model.apply(p, batch_), batch_)
+
+    _bench_lm(args, build_models=build_models, make_batch=make_batch,
+              make_loss=make_loss, knee_per_chip=8,
+              knee_note="r4 sweep: 30.4%/37.8%/36.2% MFU at 4/8/16",
+              seq_default=512,
+              metric="gpt2_124m_lm_seqs_per_sec_per_chip",
+              smoke_metric="gpt2_smoke_seqs_per_sec",
+              aa_metric="gpt2_aa_noise_floor")
 
 
 if __name__ == "__main__":
