@@ -344,8 +344,7 @@ def bench_resnet(args) -> None:
 
 
 def _bench_lm(args, *, build_models, make_batch, make_loss,
-              knee_per_chip, knee_note, seq_default, metric,
-              smoke_metric, aa_metric) -> None:
+              knee_per_chip, metric, smoke_metric, aa_metric) -> None:
     """Shared LM benchmark harness (BERT MLM / GPT-2 causal LM):
     sequences/sec/chip through the full byteps_tpu step vs a plain-JAX
     single-chip baseline. One copy of the methodology — pair
@@ -377,7 +376,8 @@ def _bench_lm(args, *, build_models, make_batch, make_loss,
             raise SystemExit(
                 f"--seq-len {seq} exceeds max_len={model.max_len} "
                 "(position embeddings would clamp silently)")
-        # Default = the measured MFU knee for this model (knee_note).
+        # Default = the measured MFU knee for this model (see the
+        # knee-sweep comment at each wrapper's call site).
         batch = args.batch or knee_per_chip * n_dev
         if args.batch and getattr(args, "batch_is_per_chip", False):
             batch = args.batch * n_dev
@@ -472,10 +472,10 @@ def bench_bert(args) -> None:
             return masked_lm_loss(model.apply(p, t), t, m)
         return loss_fn
 
+    # knee_per_chip=32 from the r3 sweep: 27.5%/44.0%/53.6% MFU at
+    # per-chip batch 8/16/32 (seq 128, baked into build_models).
     _bench_lm(args, build_models=build_models, make_batch=make_batch,
               make_loss=make_loss, knee_per_chip=32,
-              knee_note="r3 sweep: 27.5%/44.0%/53.6% MFU at 8/16/32",
-              seq_default=128,
               metric="bert_large_mlm_seqs_per_sec_per_chip",
               smoke_metric="bert_smoke_seqs_per_sec",
               aa_metric="bert_aa_noise_floor")
@@ -507,10 +507,10 @@ def bench_gpt2(args) -> None:
         from byteps_tpu.models import lm_loss
         return lambda p, batch_: lm_loss(model.apply(p, batch_), batch_)
 
+    # knee_per_chip=8 from the r4 sweep: 30.4%/37.8%/36.2% MFU at
+    # per-chip batch 4/8/16 (seq 512, baked into build_models).
     _bench_lm(args, build_models=build_models, make_batch=make_batch,
               make_loss=make_loss, knee_per_chip=8,
-              knee_note="r4 sweep: 30.4%/37.8%/36.2% MFU at 4/8/16",
-              seq_default=512,
               metric="gpt2_124m_lm_seqs_per_sec_per_chip",
               smoke_metric="gpt2_smoke_seqs_per_sec",
               aa_metric="gpt2_aa_noise_floor")

@@ -76,13 +76,15 @@ struct Van::ShmConn {
   // — tmpfs memory leaked host-wide. A second unlink is ENOENT, so the
   // connector unlinking again at teardown is always safe.
   std::string name;
-  // The fd number has TWO user threads on an shm connection: the idle
-  // TCP recv thread (EOF watch) and the shm recv thread (which passes fd
-  // to handlers that may reply on it). ::close only when the LAST user
-  // is done — closing while the shm thread still dispatches would let
-  // the kernel reuse the number for a fresh accept and route stale
-  // replies to an unrelated peer (the fd-reuse race CloseConn's contract
-  // exists to prevent).
+  // The fd number has TWO standing user threads on an shm connection —
+  // the idle TCP recv thread (EOF watch) and the shm recv thread (which
+  // passes fd to handlers that may reply on it) — plus, on the connector
+  // side only, a third transient user: the OfferShm thread while its
+  // hello send is in flight (set at registration). ::close only when the
+  // LAST user is done — closing while any user still touches the fd
+  // would let the kernel reuse the number for a fresh accept and route
+  // stale writes to an unrelated peer (the fd-reuse race CloseConn's
+  // contract exists to prevent).
   std::atomic<int> fd_users{2};
 
   ~ShmConn() {
@@ -222,8 +224,8 @@ int Van::Connect(const std::string& host, int port) {
       SizeSocketBuffers(fd);
       // send_mu_ entry + TCP recv thread first: the shm recv loop may
       // dispatch a handler that replies on this fd immediately.
-      StartRecvThread(fd);
-      if (same_host) OfferShm(fd);
+      auto smu = StartRecvThread(fd);
+      if (same_host) OfferShm(fd, smu);
       return fd;
     }
     if (fd >= 0) ::close(fd);
@@ -302,10 +304,12 @@ bool Van::Send(int fd, const MsgHeader& head, const void* payload,
   return true;
 }
 
-void Van::StartRecvThread(int fd) {
+std::shared_ptr<std::mutex> Van::StartRecvThread(int fd) {
+  auto smu = std::make_shared<std::mutex>();
   std::lock_guard<std::mutex> lk(mu_);
-  send_mu_.emplace(fd, std::make_shared<std::mutex>());
+  send_mu_[fd] = smu;
   threads_.emplace_back([this, fd] { RecvLoop(fd); });
+  return smu;
 }
 
 void Van::AcceptLoop() {
@@ -383,7 +387,7 @@ void Van::RecvLoop(int fd) {
 // Connector side: create the segment, announce it over the socket, start
 // consuming the inbound ring. Any failure leaves the connection on plain
 // TCP (no hello sent, peer never knows).
-bool Van::OfferShm(int fd) {
+bool Van::OfferShm(int fd, const std::shared_ptr<std::mutex>& smu) {
   static std::atomic<uint32_t> seq{0};
   char name[64];
   snprintf(name, sizeof(name), "/bpsvan_%d_%d_%u", getpid(), fd,
@@ -430,24 +434,45 @@ bool Van::OfferShm(int fd) {
   conn->out_ring = ShmRingData(conn->hdr, 0);
   conn->in_ring = ShmRingData(conn->hdr, 1);
 
+  // Register BEFORE sending the hello, under an identity check on the
+  // send mutex: if the peer died during shm setup above, the TCP recv
+  // thread's CloseConn already erased this fd and closed it — the number
+  // may already belong to a NEW connection (whose StartRecvThread
+  // re-inserted the same key with a FRESH mutex, which is why key
+  // presence alone is not enough). Writing the hello, or registering the
+  // ring, against a reused fd would corrupt an unrelated connection;
+  // bail and let the conn dtor unmap + unlink instead. Once registered,
+  // this thread holds a third fd_users reference, so the fd cannot be
+  // closed (hence not reused) while the hello send below is in flight.
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = send_mu_.find(fd);
+    if (stop_.load() || it == send_mu_.end() || it->second != smu)
+      return false;  // conn dtor unmaps + unlinks
+    conn->fd_users.store(3);  // TCP recv + shm recv + this hello send
+    shm_conns_[fd] = conn;
+    threads_.emplace_back([this, fd, conn] { ShmRecvLoop(fd, conn); });
+  }
   MsgHeader h{};
   h.cmd = CMD_SHM_HELLO;
   int64_t plen = static_cast<int64_t>(strlen(name));
   h.payload_len = plen;
   h.arg0 = cap;
   uint64_t total = sizeof(MsgHeader) + static_cast<uint64_t>(plen);
-  // Raw socket send: the ONLY frame this socket will ever carry. Connect
-  // has not returned the fd yet, so no concurrent Send exists.
-  if (!SendAll(fd, &total, sizeof(total)) || !SendAll(fd, &h, sizeof(h)) ||
-      !SendAll(fd, name, static_cast<size_t>(plen))) {
-    shm_unlink(name);
-    return false;  // conn dtor unmaps
-  }
-  {
-    std::lock_guard<std::mutex> lk(mu_);
-    if (stop_.load()) return false;
-    shm_conns_[fd] = conn;
-    threads_.emplace_back([this, fd, conn] { ShmRecvLoop(fd, conn); });
+  // Raw socket send: the ONLY frame this socket will ever carry —
+  // Connect has not returned the fd to callers yet, and any concurrent
+  // internal Send already routes through the just-registered ring, so
+  // the TCP byte stream stays exclusively ours. A dead peer surfaces as
+  // a send failure; the TCP recv thread's EOF handling then tears the
+  // ring down through the normal path.
+  bool sent = SendAll(fd, &total, sizeof(total)) &&
+              SendAll(fd, &h, sizeof(h)) &&
+              SendAll(fd, name, static_cast<size_t>(plen));
+  if (conn->fd_users.fetch_sub(1) == 1) ::close(fd);
+  if (!sent) {
+    BPS_LOG(WARNING) << "shm hello send failed on fd=" << fd
+                     << "; peer-loss teardown will reap the ring";
+    return false;
   }
   BPS_LOG(DEBUG) << "van fd=" << fd << " data path -> shm ring " << name
                  << " (" << cap << " B/dir)";
@@ -458,7 +483,10 @@ bool Van::OfferShm(int fd) {
 void Van::AttachShm(int fd, const Message& hello) {
   std::string name(hello.payload.data(), hello.payload.size());
   uint32_t cap = static_cast<uint32_t>(hello.head.arg0);
-  if (cap == 0 || (cap & (cap - 1)) != 0) {  // wrap-correctness invariant
+  // Wrap-correctness invariant (power of two) plus the same 1<<30 upper
+  // clamp the connector's ShmRingBytes enforces — a hello above it cannot
+  // have come from a healthy peer.
+  if (cap == 0 || (cap & (cap - 1)) != 0 || cap > (1u << 30)) {
     BPS_LOG(WARNING) << "shm hello with invalid ring capacity " << cap
                      << "; dropping connection";
     ::shutdown(fd, SHUT_RDWR);
@@ -471,6 +499,19 @@ void Van::AttachShm(int fd, const Message& hello) {
     // Close the socket — the peer's EOF handling fails it fast.
     BPS_LOG(WARNING) << "shm_open(" << name << ") failed: "
                    << strerror(errno) << "; dropping connection";
+    ::shutdown(fd, SHUT_RDWR);
+    return;
+  }
+  // The connector fallocated map_len before sending the hello, so a
+  // smaller object means truncation/mismatch — mapping it would SIGBUS on
+  // first access past EOF instead of failing cleanly here.
+  struct stat st {};
+  if (fstat(sfd, &st) != 0 ||
+      static_cast<size_t>(st.st_size) < map_len) {
+    BPS_LOG(WARNING) << "shm segment " << name << " size " << st.st_size
+                     << " < expected " << map_len
+                     << "; dropping connection";
+    ::close(sfd);
     ::shutdown(fd, SHUT_RDWR);
     return;
   }

@@ -73,13 +73,18 @@ class Van {
 
   void AcceptLoop();
   void RecvLoop(int fd);
-  void StartRecvThread(int fd);
+  // Returns the per-fd send mutex it registered — an identity token for
+  // THIS incarnation of the fd (a closed-and-reaccepted fd gets a fresh
+  // mutex), which OfferShm uses to detect fd reuse.
+  std::shared_ptr<std::mutex> StartRecvThread(int fd);
   void ShmRecvLoop(int fd, std::shared_ptr<ShmConn> conn);
   // Shared tail of both recv loops: wire accounting, PS_VERBOSE trace,
   // van-internal command handling, handler dispatch — ONE copy so the
   // transports cannot drift.
   void DispatchFrame(Message&& msg, int fd);
-  bool OfferShm(int fd);  // connector side; returns false -> stay on TCP
+  // Connector side; returns false -> stay on TCP. `smu` is the send-mutex
+  // identity StartRecvThread returned for this connection.
+  bool OfferShm(int fd, const std::shared_ptr<std::mutex>& smu);
   void AttachShm(int fd, const Message& hello);  // acceptor side
 
   Handler handler_;

@@ -89,18 +89,25 @@ def init(mesh: Optional[Mesh] = None, config: Optional[Config] = None) -> None:
                 ) from e
             ps_client = _ffi.Worker.start(cfg)
         from byteps_tpu.jax import ps as _ps
+        _ps.drain_bridge()  # no stale-session op may straddle the re-init
         _ps.reset_declare_cache()
+        _global_run_cache.clear()
         _state = _State(cfg, mesh, registry, ps_client)
 
 
 def shutdown() -> None:
     """Tear down (reference: byteps_shutdown)."""
     global _state
+    from byteps_tpu.jax import ps as _ps
+    # Settle in-flight async bridge ops BEFORE taking the lock or touching
+    # the C++ client: a pending push_pull_async still holds staged host
+    # buffers the core pulls into, and must complete against a live fleet.
+    _ps.drain_bridge()
     with _lock:
         if _state is not None and _state.ps_client is not None:
             _state.ps_client.shutdown()
-        from byteps_tpu.jax import ps as _ps
         _ps.reset_declare_cache()
+        _global_run_cache.clear()
         _state = None
 
 
@@ -206,13 +213,17 @@ def push_pull(tree, average: bool = True, name: Optional[str] = None,
     all-reduce (SURVEY.md §3.3's REDUCE→PUSH/PULL→BROADCAST pipeline as one
     fused XLA program). Outside, arrays must carry a leading replica axis of
     length ``device_count()`` — this process's mesh size — (stacked
-    per-chip values) and the same collective runs under a jitted
-    shard_map.
+    per-chip values) and the same collective runs under a jitted shard_map;
+    in PS mode the result then crosses the host boundary once more through
+    the C++ KV client, so the reduction is global across worker processes
+    (Horovod semantics), not just across this host's chips. ``name`` keys
+    the PS registry for that leg; unnamed calls share a shape-keyed name
+    and must be issued in the same order on every worker.
     """
     ici, dcn = _axes()
     if _inside_spmd(ici) or _inside_spmd(dcn):
         return _per_device_push_pull(tree, average, compression)
-    return _global_push_pull(tree, average, compression)
+    return _global_push_pull(tree, average, compression, name)
 
 
 def _per_device_push_pull(tree, average, compression):
@@ -231,7 +242,30 @@ def _per_device_push_pull(tree, average, compression):
         lambda x, d: compression.decompress(x, d), red, orig_dtypes)
 
 
-def _global_push_pull(tree, average, compression):
+# (mesh, mesh_axes, average, compression) -> jitted host-level reducer.
+# Without this cache every host-level push_pull would build a FRESH
+# closure, and jax.jit's cache (keyed on function identity) would retrace
+# and recompile per call — seconds per step for a per-step API. Cleared by
+# init()/shutdown() (a new mesh keys differently anyway).
+_global_run_cache: dict = {}
+
+
+def _global_run(mesh, mesh_axes, average, compression):
+    key = (mesh, mesh_axes, average, compression)
+    run = _global_run_cache.get(key)
+    if run is None:
+        @partial(jax.jit)
+        @partial(_shard_map, mesh=mesh, in_specs=P(mesh_axes),
+                 out_specs=P(), check_vma=False)
+        def run(stacked):
+            local = jax.tree_util.tree_map(lambda x: x[0], stacked)
+            return _per_device_push_pull(local, average, compression)
+
+        _global_run_cache[key] = run
+    return run
+
+
+def _global_push_pull(tree, average, compression, name=None):
     st = _st()
     n = st.mesh.size
     ici, dcn = _axes()
@@ -249,39 +283,71 @@ def _global_push_pull(tree, average, compression):
                 f"{leaf.shape}. Inside a shard_map'd step, call push_pull "
                 "on the per-device gradients directly.")
 
-    @partial(jax.jit)
-    @partial(_shard_map, mesh=st.mesh, in_specs=P(mesh_axes),
-             out_specs=P(), check_vma=False)
-    def _run(stacked):
-        local = jax.tree_util.tree_map(lambda x: x[0], stacked)
-        return _per_device_push_pull(local, average, compression)
-
-    return _run(tree)
+    out = _global_run(st.mesh, mesh_axes, average, compression)(tree)
+    if st.ps_client is not None:
+        # Cross-worker DCN leg: the in-jit collective covered only this
+        # process's chips (the mesh is process-local in PS mode), so a
+        # host-level push_pull must still cross the PS fleet to keep
+        # Horovod-global semantics. The denominator factorises: local
+        # pmean over n chips, then PS average over equal workers.
+        from byteps_tpu.jax import ps as _ps
+        out = _ps.ps_push_pull(out, average=average,
+                               prefix=name or "push_pull")
+    return out
 
 
 # --- async handle surface (reference: handle_manager.cc + ops.py) ----------
 
 @dataclasses.dataclass
 class Handle:
-    """An in-flight push_pull (JAX async dispatch is the handle table)."""
+    """An in-flight push_pull. In collective mode JAX's async dispatch IS
+    the handle table (``value`` holds not-yet-ready arrays); in PS mode
+    ``value`` is a Future for the host-side DCN round trip running on the
+    bridge thread."""
 
     value: Any
 
 
 def push_pull_async(tree, average: bool = True, name: Optional[str] = None,
                     compression: Compressor = Compression.none) -> Handle:
+    """Non-blocking push_pull (reference: push_pull_async + handle table).
+
+    Collective mode: XLA's async dispatch means the jitted collective is
+    already in flight when this returns. PS mode: the host-level DCN leg
+    (device_get → C++ push/pull → device_put) runs on the ordered bridge
+    thread (byteps_tpu.jax.ps) so this call returns immediately, the
+    fleet round trip overlaps with the caller's other host work, and
+    declares stay in fleet-consistent order against synchronous calls;
+    ``synchronize`` joins it.
+    """
+    st = _st()
+    ici, dcn = _axes()
+    inside = _inside_spmd(ici) or _inside_spmd(dcn)
+    if st.ps_client is not None and not inside:
+        from byteps_tpu.jax import ps as _ps
+        fut = _ps.submit_ordered(
+            _global_push_pull, tree, average, compression, name)
+        return Handle(fut)
     return Handle(push_pull(tree, average=average, name=name,
                             compression=compression))
 
 
+def _is_future(v) -> bool:
+    return hasattr(v, "done") and hasattr(v, "result")
+
+
 def poll(handle: Handle) -> bool:
     """True iff the result is materialised (reference: byteps_torch_poll)."""
+    if _is_future(handle.value):
+        return handle.value.done()
     leaves = jax.tree_util.tree_leaves(handle.value)
     return all(l.is_ready() for l in leaves if hasattr(l, "is_ready"))
 
 
 def synchronize(handle: Handle):
     """Block until the result is ready and return it."""
+    if _is_future(handle.value):
+        return jax.block_until_ready(handle.value.result())
     return jax.block_until_ready(handle.value)
 
 
@@ -294,32 +360,51 @@ def declare_tensor(name: str, shape, dtype) -> None:
     _st().registry.declare(name, tuple(shape), jnp.dtype(dtype).name)
 
 
-def broadcast_parameters(tree, root_rank: int = 0):
+def broadcast_parameters(tree, root_rank: int = 0,
+                         name: Optional[str] = None):
     """Replicate ``tree`` from ``root_rank``'s copy to all chips (reference:
     broadcast_parameters, SURVEY.md §3.4).
 
     Inside shard_map: a masked-psum broadcast over both axes. Outside, with
-    single-controller JAX, parameters are already logically replicated, so
-    this devolves to installing a fully-replicated sharding — the TPU-native
-    equivalent of init-time weight sync.
+    single-controller JAX, this host's chips are already logically
+    replicated, so locally it devolves to installing a fully-replicated
+    sharding; in PS mode the tree additionally round-trips through the
+    servers so every worker process ends up holding ``root_rank``'s values
+    (the reference's init-time weight sync, SURVEY.md §3.4). ``name`` keys
+    the PS registry for that leg — distinct same-shaped trees broadcast
+    from different call sites should pass distinct names (unnamed calls
+    share a shape-keyed name and must be issued in the same order on
+    every worker).
     """
     ici, dcn = _axes()
     if _inside_spmd(ici) or _inside_spmd(dcn):
         return _h.tree_broadcast(tree, root=root_rank,
                                  ici_axis=ici, dcn_axis=dcn)
     st = _st()
+    if st.ps_client is not None:
+        from byteps_tpu.jax import ps as _ps
+        tree = _ps.ps_broadcast(tree, root_rank=root_rank,
+                                prefix=name or "param")
     repl = jax.sharding.NamedSharding(st.mesh, P())
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, repl), tree)
 
 
-def broadcast_optimizer_state(opt_state, root_rank: int = 0):
+def broadcast_optimizer_state(opt_state, root_rank: int = 0,
+                              name: str = "opt_state"):
     """Replicate optimizer state from ``root_rank`` (reference:
-    broadcast_optimizer_state). optax states are pytrees of arrays, so
-    this shares broadcast_parameters' mechanics; non-array leaves (python
-    scalars, schedule callables) pass through untouched."""
-    return jax.tree_util.tree_map(
-        lambda x: broadcast_parameters(x, root_rank=root_rank)
-        if hasattr(x, "dtype") else x, opt_state)
+    broadcast_optimizer_state). optax states are pytrees of arrays;
+    non-array leaves (python scalars, schedule callables) pass through
+    untouched. All array leaves go through ONE broadcast_parameters call
+    (one batched host round trip in PS mode, not one per leaf); pass a
+    distinct ``name`` when broadcasting several optimizer states."""
+    leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+    arr_idx = [i for i, l in enumerate(leaves) if hasattr(l, "dtype")]
+    if arr_idx:
+        synced = broadcast_parameters([leaves[i] for i in arr_idx],
+                                      root_rank=root_rank, name=name)
+        for i, v in zip(arr_idx, synced):
+            leaves[i] = v
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 # --- DistributedOptimizer ---------------------------------------------------

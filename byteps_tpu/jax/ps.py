@@ -14,6 +14,7 @@ and the COPYD2H → PUSH → PULL → COPYH2D pipeline stages.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 import jax
@@ -21,6 +22,57 @@ import jax.numpy as jnp
 import numpy as np
 
 import byteps_tpu.jax as bps
+
+# --- ordered bridge execution ----------------------------------------------
+# Wire keys are (declaration-order id << 16 | partition) — worker.cc's
+# Declare assigns ids by LOCAL declaration order, so every worker must
+# declare tensors in the same order or the servers sum unrelated tensors
+# under one key. A single FIFO bridge thread gives that order a single
+# authority: every host-boundary PS op (sync or async) executes on it in
+# submission order, and submissions happen in the caller's program order.
+_pool = None
+_pool_lock = threading.Lock()
+_POOL_PREFIX = "bps_bridge"
+
+
+def _ensure_pool():
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            import concurrent.futures
+            _pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=_POOL_PREFIX)
+        return _pool
+
+
+def _on_pool_thread() -> bool:
+    return threading.current_thread().name.startswith(_POOL_PREFIX)
+
+
+def _run_ordered(fn, *args, **kwargs):
+    """Execute fn on the bridge thread and wait. Re-entrant: a call that is
+    already ON the bridge thread (an async op's PS leg) runs inline — a
+    submit-and-wait there would deadlock the single-worker FIFO."""
+    if _on_pool_thread():
+        return fn(*args, **kwargs)
+    return _ensure_pool().submit(fn, *args, **kwargs).result()
+
+
+def submit_ordered(fn, *args, **kwargs):
+    """Queue fn on the bridge thread and return the Future (the async
+    handle path). Caller must not already be on the bridge thread."""
+    assert not _on_pool_thread(), "async submit from the bridge thread"
+    return _ensure_pool().submit(fn, *args, **kwargs)
+
+
+def drain_bridge() -> None:
+    """Settle every queued bridge op and retire the pool (shutdown path:
+    the C++ client must not be torn down under an in-flight async op)."""
+    global _pool
+    with _pool_lock:
+        p, _pool = _pool, None
+    if p is not None:
+        p.shutdown(wait=True)
 
 # (prefix, n_leaves) -> list of tensor ids. Declares are per-tensor-
 # lifetime, not per-step: each declare is a ctypes call into the C core's
@@ -114,14 +166,22 @@ def _tids(client, prefix: str, leaves, plan):
     global declare_steps
     # Shape/dtype signature in the key: a same-named tree with different
     # leaf sizes must re-declare (the C core rejects size changes).
-    key = (prefix, tuple((int(l.size), str(l.dtype)) for l in leaves),
-           tuple(p[0] for p in plan))
+    sig = tuple((int(l.size), str(l.dtype)) for l in leaves)
+    key = (prefix, sig, tuple(p[0] for p in plan))
     tids = _tid_cache.get(key)
     if tids is None:
         declare_steps += 1
+        # The shape signature goes INTO the wire name: two different-shaped
+        # trees under the same prefix (e.g. two unnamed push_pull call
+        # sites) must land on distinct server tensors — re-declaring a
+        # name with a new size is a deliberate fatal in the C core. The
+        # digest is content-derived, so it is identical on every worker
+        # (python's hash() is salted per process and would NOT be).
+        import zlib
+        shape_key = zlib.crc32(repr(key).encode())
         tids = [
-            client.declare(f"{prefix}_{i}", int(leaf.size), wire_dtype,
-                           compression=comp)
+            client.declare(f"{prefix}_{shape_key:08x}_{i}", int(leaf.size),
+                           wire_dtype, compression=comp)
             for i, (leaf, (wire_dtype, comp)) in enumerate(zip(leaves,
                                                                plan))
         ]
@@ -145,8 +205,14 @@ def ps_push_pull(tree, average: bool = True, prefix: str = "grad",
     overlaps per-leaf transfers), the resulting host buffers are handed
     to the C core zero-copy (pushed from and pulled back into in place),
     and tensor declares are cached for the tree's lifetime instead of
-    re-registering every step.
+    re-registering every step. Executes on the FIFO bridge thread so
+    declares keep a fleet-consistent order against async ops.
     """
+    return _run_ordered(_ps_push_pull_impl, tree, average, prefix,
+                        async_mode)
+
+
+def _ps_push_pull_impl(tree, average, prefix, async_mode):
     st = bps._st()
     client = st.ps_client
     if client is None:
@@ -191,7 +257,12 @@ def ps_push_pull(tree, average: bool = True, prefix: str = "grad",
 
 def ps_broadcast(tree, root_rank: int = 0, prefix: str = "param"):
     """Init-time weight sync across worker hosts through the servers
-    (reference: broadcast_parameters, SURVEY.md §3.4)."""
+    (reference: broadcast_parameters, SURVEY.md §3.4). Bridge-thread
+    ordered like ps_push_pull."""
+    return _run_ordered(_ps_broadcast_impl, tree, root_rank, prefix)
+
+
+def _ps_broadcast_impl(tree, root_rank, prefix):
     st = bps._st()
     client = st.ps_client
     if client is None:

@@ -45,9 +45,9 @@ def make_train_step(
     is jitted with donated params/opt_state (in-place buffer reuse in HBM).
 
     ``ps_prefix`` names this step's gradient tensors in the PS registry
-    (PS mode only). Two step builders in one process must use different
-    prefixes unless their gradient trees have identical shapes AND wire
-    dtypes — the C core rejects re-declaring a name with a new dtype.
+    (PS mode only). Wire names carry the tree's shape/dtype signature, so
+    two step builders may share a prefix; distinct prefixes still help
+    trace readability.
     """
     mesh = mesh or bps.mesh()
     cfg = bps._st().config

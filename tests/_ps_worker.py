@@ -22,6 +22,8 @@ def main() -> int:
         return jax_overlap_main()
     if mode == "jax_bridge":
         return jax_bridge_main()
+    if mode == "jax_global":
+        return jax_global_main()
     if mode == "jax_timeline":
         return jax_timeline_main()
     if mode == "mxnet_stub":
@@ -573,6 +575,75 @@ def jax_bridge_main() -> int:
             np.testing.assert_allclose(np.asarray(leaf), expect, rtol=1e-6)
         print(f"worker {rank}: jax_bridge OK "
               f"({dt * 1e3:.2f} ms/step, 64 leaves x 257 f32)")
+        return 0
+    finally:
+        bps_jax.shutdown()
+
+
+def jax_global_main() -> int:
+    """Horovod-global semantics of the BARE jax-level API in PS mode: a
+    user's ``bps.push_pull`` / ``bps.broadcast_parameters`` at host level
+    must cross the worker fleet through the servers, not silently reduce
+    over this process's chips only (round-5 regression: the host-level
+    path used to skip the DCN leg)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import byteps_tpu.jax as bps_jax
+
+    bps_jax.init()
+    try:
+        client = bps_jax._st().ps_client
+        assert client is not None
+        rank, nw = client.worker_rank(), client.num_workers()
+        n_dev = bps_jax._st().mesh.size
+
+        # push_pull: stacked over local devices, summed across the fleet
+        for i in range(2):
+            x = jnp.full((n_dev, 1000), float(rank + 1), jnp.float32)
+            out = bps_jax.push_pull(x, average=False, name=f"g{i}")
+            expect = n_dev * sum(r + 1 for r in range(nw))
+            np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+        # average=True: global mean over n_dev x nw replicas
+        x = jnp.full((n_dev, 64), float(rank + 1), jnp.float32)
+        out = bps_jax.push_pull(x, average=True, name="gavg")
+        expect = sum(r + 1 for r in range(nw)) / nw
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+        # unnamed calls with DIFFERENT tree shapes must not collide in
+        # the PS registry (shape-keyed wire names, not a fatal re-declare)
+        a = bps_jax.push_pull(jnp.full((n_dev, 16), float(rank + 1)),
+                              average=False)
+        b = bps_jax.push_pull(jnp.full((n_dev, 48), float(rank + 1)),
+                              average=False)
+        expect = n_dev * sum(r + 1 for r in range(nw))
+        np.testing.assert_allclose(np.asarray(a), expect, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(b), expect, rtol=1e-6)
+
+        # async handles: immediate return, poll converges, result exact
+        h = bps_jax.push_pull_async(
+            jnp.full((n_dev, 256), float(rank + 1), jnp.float32),
+            average=False, name="ah")
+        out = bps_jax.synchronize(h)
+        assert bps_jax.poll(h)
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+        # broadcast_parameters: every worker ends with rank 0's values
+        val = (np.arange(500, dtype=np.float32) if rank == 0
+               else np.zeros(500, np.float32))
+        tree = {"w": jnp.asarray(val)}
+        tree = bps_jax.broadcast_parameters(tree, root_rank=0)
+        np.testing.assert_allclose(np.asarray(tree["w"]),
+                                   np.arange(500, dtype=np.float32))
+
+        # broadcast_optimizer_state: arrays sync, python scalars pass
+        opt = {"mu": jnp.full((37,), float(rank)), "count": 7,
+               "nu": jnp.full((11,), float(rank * 2))}
+        opt = bps_jax.broadcast_optimizer_state(opt, root_rank=0)
+        np.testing.assert_allclose(np.asarray(opt["mu"]), 0.0)
+        np.testing.assert_allclose(np.asarray(opt["nu"]), 0.0)
+        assert opt["count"] == 7
+        print(f"worker {rank}: jax_global OK")
         return 0
     finally:
         bps_jax.shutdown()

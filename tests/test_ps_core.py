@@ -285,6 +285,14 @@ def test_jax_ps_single_worker_force_distributed():
                         "BYTEPS_FORCE_DISTRIBUTED": "1"}, timeout=180)
 
 
+def test_jax_global_api_crosses_fleet():
+    """Bare ``bps.push_pull``/``broadcast_parameters`` at host level must
+    have Horovod-GLOBAL semantics in PS mode — local chip reduction chained
+    with the PS DCN leg — not a silent process-local reduction."""
+    run_topology(2, 1, WORKER, mode="jax_global",
+                 extra={"BYTEPS_PS_MODE": "ps"}, timeout=180)
+
+
 def test_jax_ps_bridge_declare_caching():
     """The JAX<->PS bridge registers each tensor once per lifetime (tid
     cache), not once per step (VERDICT r1 missing #2: host-boundary
