@@ -17,6 +17,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
+#include <cstring>
 #include <functional>
 #include <mutex>
 #include <queue>
@@ -32,6 +33,18 @@ inline bool QueueDebug() {
   return on;
 }
 
+// BYTEPS_SCHEDULING=fifo disables the priority order (pure enqueue
+// order). Exists for A/B measurement of the scheduler's benefit
+// (tools/bench_priority.py) and as an escape hatch; "priority" (default)
+// is the reference behavior.
+inline bool FifoScheduling() {
+  static const bool fifo = [] {
+    const char* v = getenv("BYTEPS_SCHEDULING");
+    return v && strcmp(v, "fifo") == 0;
+  }();
+  return fifo;
+}
+
 struct Task {
   int priority = 0;       // higher = sooner
   int64_t seq = 0;        // FIFO tie-break within a priority level
@@ -42,7 +55,8 @@ struct Task {
 
 struct TaskOrder {
   bool operator()(const Task& a, const Task& b) const {
-    if (a.priority != b.priority) return a.priority < b.priority;  // max-heap
+    if (!FifoScheduling() && a.priority != b.priority)
+      return a.priority < b.priority;  // max-heap
     return a.seq > b.seq;  // earlier enqueue first
   }
 };

@@ -125,13 +125,27 @@ int64_t BytePSWorker::Declare(const std::string& name, int64_t nelem,
   int64_t per_part = std::max<int64_t>(1, partition_bytes_ / esz);
   int64_t nparts = (nelem + per_part - 1) / per_part;
   int ns = po_->num_servers();
+  // Byte-balanced server assignment: each partition goes to the server
+  // with the least bytes assigned so far (ties -> lowest index, so the
+  // choice is deterministic). Every worker declares the same tensors in
+  // the same order, so all workers compute the same mapping without any
+  // coordination. Round-robin by (tid + i) was measured 22% hot at 8
+  // servers on the ResNet-50 leaf distribution (tools/bench_scaling.py)
+  // — and the hottest server's links gate the whole sync round.
+  if (server_bytes_.size() != static_cast<size_t>(ns)) {
+    server_bytes_.assign(ns, 0);
+  }
   for (int64_t i = 0; i < nparts; ++i) {
     Part p;
     p.key = (ctx->id << 16) | i;
-    p.server_id = Postoffice::ServerId(
-        static_cast<int>((ctx->id + i) % ns));
+    int best = 0;
+    for (int s = 1; s < ns; ++s) {
+      if (server_bytes_[s] < server_bytes_[best]) best = s;
+    }
+    p.server_id = Postoffice::ServerId(best);
     p.offset = i * per_part;
     p.len = std::min(per_part, nelem - p.offset);
+    server_bytes_[best] += p.len * esz;
     if (!comp.empty()) {
       p.comp = CreateCompressor(comp, p.len);
     }

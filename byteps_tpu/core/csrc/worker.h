@@ -116,6 +116,9 @@ class BytePSWorker {
   std::condition_variable cv_;
   std::unordered_map<std::string, int64_t> by_name_;
   std::vector<std::unique_ptr<TensorCtx>> tensors_;
+  // Cumulative bytes assigned per server (guarded by mu_): drives the
+  // byte-balanced partition->server mapping in Declare.
+  std::vector<int64_t> server_bytes_;
   std::unordered_map<int, std::shared_ptr<Handle>> handles_;
   int next_handle_ = 0;
   std::string last_error_;  // guarded by mu_

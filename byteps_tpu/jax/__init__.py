@@ -68,6 +68,11 @@ def init(mesh: Optional[Mesh] = None, config: Optional[Config] = None) -> None:
     registry, and — in PS mode — the C++ KV client connection to the
     scheduler."""
     global _state
+    # Drain BEFORE touching any global state (and before the C core is
+    # re-initialised below): a stale async op from a previous session must
+    # fully settle against the OLD client, not straddle the re-init.
+    from byteps_tpu.jax import ps as _ps_drain
+    _ps_drain.drain_bridge()
     with _lock:
         cfg = config or get_config(reload=True)
         if mesh is None:
@@ -89,7 +94,6 @@ def init(mesh: Optional[Mesh] = None, config: Optional[Config] = None) -> None:
                 ) from e
             ps_client = _ffi.Worker.start(cfg)
         from byteps_tpu.jax import ps as _ps
-        _ps.drain_bridge()  # no stale-session op may straddle the re-init
         _ps.reset_declare_cache()
         _global_run_cache.clear()
         _state = _State(cfg, mesh, registry, ps_client)
@@ -338,9 +342,16 @@ def _is_future(v) -> bool:
 
 def poll(handle: Handle) -> bool:
     """True iff the result is materialised (reference: byteps_torch_poll)."""
-    if _is_future(handle.value):
-        return handle.value.done()
-    leaves = jax.tree_util.tree_leaves(handle.value)
+    value = handle.value
+    if _is_future(value):
+        if not value.done():
+            return False
+        # The bridge op ends with a non-blocking device_put; "done" means
+        # the fleet round trip finished, not that the H2D transfers have
+        # landed — hold poll() to the same is_ready bar as the
+        # collective branch.
+        value = value.result()
+    leaves = jax.tree_util.tree_leaves(value)
     return all(l.is_ready() for l in leaves if hasattr(l, "is_ready"))
 
 

@@ -199,10 +199,18 @@ def main() -> int:
                 assert len(early_ts) == 4 and len(late_ts) == 4
                 if min(early_ts) < min(late_ts):
                     signal += 1
-            assert signal >= 1, (
-                f"no priority preemption observed in {rounds} rounds: "
-                "the earlier-declared tensor never popped ahead of the "
-                "later-declared one enqueued before it")
+            if os.environ.get("BYTEPS_SCHEDULING") == "fifo":
+                # A/B inverse: under FIFO the earlier-declared tensor can
+                # NEVER jump ahead of the later one enqueued before it —
+                # the signature must vanish entirely.
+                assert signal == 0, (
+                    f"FIFO mode showed priority preemption in {signal} "
+                    "rounds — BYTEPS_SCHEDULING=fifo is not honored")
+            else:
+                assert signal >= 1, (
+                    f"no priority preemption observed in {rounds} rounds: "
+                    "the earlier-declared tensor never popped ahead of the "
+                    "later-declared one enqueued before it")
 
         elif mode == "deep_pipeline":
             # 4 rounds of ONE tensor in flight before any wait: rounds

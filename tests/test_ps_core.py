@@ -74,6 +74,20 @@ def test_priority_preemption(tmp_path):
                         "BPS_TRACE_OUT": str(tmp_path)})
 
 
+def test_fifo_mode_disables_preemption(tmp_path):
+    """BYTEPS_SCHEDULING=fifo (the A/B switch behind
+    tools/bench_priority.py): the priority signature — an
+    earlier-declared tensor popping ahead of a later-declared one that
+    entered the queue first — must NEVER appear."""
+    run_topology(1, 1, WORKER, mode="priority",
+                 extra={"BYTEPS_PARTITION_BYTES": "65536",
+                        "BYTEPS_SCHEDULING_CREDIT": "65536",
+                        "BYTEPS_SCHEDULING": "fifo",
+                        "BYTEPS_FORCE_DISTRIBUTED": "1",
+                        "BYTEPS_TRACE_ON": "1",
+                        "BPS_TRACE_OUT": str(tmp_path)})
+
+
 def test_deep_pipelining_one_tensor():
     """3+ rounds of one tensor in flight: the server must park (not
     fail-stop on) pushes for a round whose slot is still busy, and every

@@ -1,0 +1,181 @@
+"""Quantify the priority scheduler: priority vs FIFO vs credit=inf.
+
+VERDICT r4 #3: the signature claim — earlier-declared (front-of-model)
+gradients' pulls complete sooner, so the NEXT forward pass can start
+before the whole tree has synced — had correctness evidence (pop-order
+trace assertions) but no *performance* number. This bench produces it.
+
+Setup: a GPT-2-124M-shaped gradient tree (tools/model_shapes.json, f16
+wire) over a kernel-paced link (BYTEPS_PACING_RATE). The worker emulates
+a backward pass: gradients are enqueued in REVERSE declaration order
+(the last layer's grad materialises first — exactly why the reference
+schedules by priority rather than arrival), optionally spread over
+``--backward-ms``. It then measures, per scheduling mode:
+
+  t_first_pull   — when the FIRST-declared tensor's pull completes (the
+                   embedding/layer-0 params the next forward needs first)
+  t_front_prefix — when the front 25% of bytes have all pulled (proxy
+                   for "next forward unblocked through the early layers")
+  t_step         — full tree synced
+
+Modes: priority (default), fifo (BYTEPS_SCHEDULING=fifo), and
+priority+credit=inf (credit so large the queue never holds anything —
+shows the credit cap is what gives priority its leverage: an admitted
+task cannot be preempted, so an uncapped queue degenerates to arrival
+order).
+
+Run: PYTHONPATH=. python tools/bench_priority.py --out BENCH_priority_r05.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.shaped_fleet import (  # noqa: E402
+    cpu_busy_since, load_model_sizes, run_fleet)
+
+
+def worker_main(args) -> None:
+    import numpy as np
+
+    from byteps_tpu.core import Worker
+
+    sizes = load_model_sizes(args.model)
+    w = Worker.start()
+    dtype = args.wire
+    esz = np.dtype(dtype).itemsize
+    tids = [w.declare(f"pr_{i}", n, dtype, compression="")
+            for i, n in enumerate(sizes)]
+    arrs = [np.ones(n, dtype=dtype) for n in sizes]
+
+    total = sum(n * esz for n in sizes)
+    # Front prefix: smallest k with sum(bytes[:k]) >= 25% of the tree.
+    acc, k_front = 0, 0
+    for i, n in enumerate(sizes):
+        acc += n * esz
+        if acc >= total // 4:
+            k_front = i + 1
+            break
+
+    def one_round(record: bool):
+        # Backward emits grads last-layer-first; spread over backward_ms.
+        order = list(range(len(tids)))[::-1]
+        gap = (args.backward_ms / 1e3 / len(order)
+               if args.backward_ms > 0 else 0.0)
+        handles = [None] * len(tids)
+        t0 = time.perf_counter()
+        for j in order:
+            handles[j] = w.push_pull(tids[j], arrs[j], average=False)
+            if gap:
+                time.sleep(gap)
+        # Wait front-to-back: wait(h) is passive, so t_first/t_prefix are
+        # completion times of those tensors, not wait-loop artifacts.
+        w.wait(handles[0])
+        t_first = time.perf_counter() - t0
+        for j in range(1, k_front):
+            w.wait(handles[j])
+        t_prefix = time.perf_counter() - t0
+        for j in range(k_front, len(handles)):
+            w.wait(handles[j])
+        t_step = time.perf_counter() - t0
+        if record:
+            return {"t_first_pull_s": round(t_first, 3),
+                    "t_front_prefix_s": round(t_prefix, 3),
+                    "t_step_s": round(t_step, 3)}
+        return None
+
+    one_round(record=False)  # warm: connections, INIT_KEY
+    w.barrier()
+    recs = [one_round(record=True) for _ in range(args.rounds)]
+    med = {k: sorted(r[k] for r in recs)[len(recs) // 2]
+           for k in recs[0]}
+    med.update({"rank": w.worker_rank(), "front_tensors": k_front,
+                "front_frac_bytes": round(acc / total, 3)})
+    print(json.dumps(med), flush=True)
+    w.shutdown()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="gpt2_124m")
+    p.add_argument("--wire", default="float16")
+    p.add_argument("--nic-gbit", type=float, default=0.2)
+    p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--servers", type=int, default=2)
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--backward-ms", type=float, default=0.0,
+                   help="spread the reverse-order enqueues over this long "
+                        "(emulated backward pass); 0 = all at once")
+    p.add_argument("--partition-mb", type=float, default=1.0)
+    p.add_argument("--out", default="")
+    p.add_argument("--role", default="")
+    args = p.parse_args()
+    if args.role == "worker":
+        return worker_main(args)
+
+    part = int(args.partition_mb * (1 << 20))
+    pace = int(args.nic_gbit * 1e9 / 8 / args.servers)
+    bdp_credit = 4 * part * args.servers
+    modes = [
+        ("priority", {"BYTEPS_SCHEDULING_CREDIT": str(bdp_credit)}),
+        ("fifo", {"BYTEPS_SCHEDULING": "fifo",
+                  "BYTEPS_SCHEDULING_CREDIT": str(bdp_credit)}),
+        ("priority_credit_inf",
+         {"BYTEPS_SCHEDULING_CREDIT": str(1 << 40)}),
+    ]
+    out = {
+        "what": ("priority scheduler quantified: reverse-order (backward) "
+                 "enqueues of a GPT-2-124M-shaped tree; time until the "
+                 "front-of-model tensors' pulls complete, per scheduling "
+                 "mode, same paced link"),
+        "model": args.model, "wire": args.wire,
+        "nic_gbit": args.nic_gbit, "servers": args.servers,
+        "partition_bytes": part, "bdp_credit_bytes": bdp_credit,
+        "backward_ms": args.backward_ms, "rounds": args.rounds,
+        "modes": {},
+    }
+    for name, env in modes:
+        env = dict(env, BYTEPS_PACING_RATE=str(pace),
+                   BYTEPS_PARTITION_BYTES=str(part))
+        _, snap = cpu_busy_since(None)
+        rc, recs = run_fleet(
+            args.workers, args.servers,
+            [os.path.abspath(__file__), "--role", "worker",
+             "--model", args.model, "--wire", args.wire,
+             "--rounds", str(args.rounds),
+             "--backward-ms", str(args.backward_ms)],
+            env_extra=env)
+        busy, _ = cpu_busy_since(snap)
+        if rc != 0 or len(recs) != args.workers:
+            raise SystemExit(f"mode={name} failed rc={rc}")
+        r = recs[0]
+        r["cpu_busy"] = busy
+        out["modes"][name] = r
+        print(json.dumps({name: r}), flush=True)
+    pr = out["modes"]["priority"]
+    ff = out["modes"]["fifo"]
+    out["speedup_first_pull"] = round(
+        ff["t_first_pull_s"] / pr["t_first_pull_s"], 2)
+    out["speedup_front_prefix"] = round(
+        ff["t_front_prefix_s"] / pr["t_front_prefix_s"], 2)
+    out["step_overhead_vs_fifo"] = round(
+        pr["t_step_s"] / ff["t_step_s"], 3)
+    print(json.dumps({
+        "metric": "priority_front_prefix_speedup",
+        "value": out["speedup_front_prefix"],
+        "unit": "x earlier next-forward unblock vs FIFO",
+    }))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps({"artifact": args.out}))
+
+
+if __name__ == "__main__":
+    main()
