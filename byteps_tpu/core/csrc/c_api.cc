@@ -264,6 +264,23 @@ void bps_net_bytes(long long* sent, long long* recv) {
   *recv = gl->po ? gl->po->van().bytes_recv() : 0;
 }
 
+// Async-mode staleness stats (cumulative): per async pull, the number of
+// fleet-wide pushes the server applied between this worker's push and
+// its pull. samples==0 means no async pulls have completed.
+void bps_async_staleness(double* mean, long long* max_, long long* n) {
+  BytePSWorker* w = g()->worker.get();
+  if (!w) {
+    *mean = 0.0;
+    *max_ = 0;
+    *n = 0;
+    return;
+  }
+  long long sum, cnt;
+  w->StalenessStats(&sum, max_, &cnt);
+  *n = cnt;
+  *mean = cnt > 0 ? static_cast<double>(sum) / cnt : 0.0;
+}
+
 // Scheduler-side failure detection: ids of nodes with expired heartbeats.
 int bps_dead_nodes(int* out, int max) {
   auto dead = g()->po->DeadNodes();

@@ -132,6 +132,12 @@ void BytePSServer::Process(Message&& msg, int fd) {
         } else {
           CpuReducer::Sum(ks->param.data(), data, data_len, ks->dtype);
         }
+        // Fleet-wide apply counter for this key: carried back on the ack
+        // (and on async pull responses), so workers can measure the
+        // STALENESS of each pull — how many pushes (anyone's) were
+        // applied between their push and their pull. Per-key engine
+        // threads make the increment race-free.
+        ++ks->async_pushes;
       } else {
         int slot = h.version & 1;
         if (ks->push_count[slot] == 0) {
@@ -173,6 +179,7 @@ void BytePSServer::Process(Message&& msg, int fd) {
       ack.sender = po_->my_id();
       ack.key = h.key;
       ack.req_id = h.req_id;
+      if (is_async) ack.arg1 = ks->async_pushes;
       po_->van().Send(fd, ack);
       break;
     }
@@ -187,6 +194,7 @@ void BytePSServer::Process(Message&& msg, int fd) {
         resp.key = h.key;
         resp.req_id = h.req_id;
         resp.dtype = ks->dtype;
+        resp.arg1 = ks->async_pushes;
         BPS_CHECK(ks->param_init) << "async pull before any push " << h.key;
         po_->van().Send(fd, resp, ks->param.data(), ks->param.size());
       } else {

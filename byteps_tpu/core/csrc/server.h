@@ -66,6 +66,10 @@ class BytePSServer {
     // async mode: server-resident value
     std::vector<char> param;
     bool param_init = false;
+    // Total async pushes applied to this key (any worker). Returned on
+    // async acks/pull responses (arg1) so workers can compute pull
+    // staleness; single-writer per key via the hash-routed engine.
+    int64_t async_pushes = 0;
     // Broadcast: per-round buffers keyed by the root's round counter
     // (head.version). A round-r BCAST_PULL is served exactly round r's
     // bytes — never a previous or FUTURE round's, even when the root

@@ -70,6 +70,12 @@ class Van {
 
  private:
   struct ShmConn;  // mapped segment + role (van.cc)
+  // MSG_ZEROCOPY per-fd completion bookkeeping (BYTEPS_VAN_ZEROCOPY=1;
+  // van.cc zerocopy block). Touched only under the per-fd send lock.
+  struct ZcState {
+    uint32_t next = 0;              // zerocopy sends issued on this fd
+    uint32_t reaped = 0xFFFFFFFFu;  // highest completed (-1 = none yet)
+  };
 
   void AcceptLoop();
   void RecvLoop(int fd);
@@ -101,6 +107,8 @@ class Van {
   // open) TCP fd. Send() consults this under the per-fd send lock, so a
   // connection's frames never interleave across transports.
   std::unordered_map<int, std::shared_ptr<ShmConn>> shm_conns_;
+  // fds armed for MSG_ZEROCOPY sends (SO_ZEROCOPY accepted at setup).
+  std::unordered_map<int, std::shared_ptr<ZcState>> zc_;
   std::vector<std::thread> threads_;
 };
 

@@ -240,6 +240,10 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
               fprintf(stderr, "[QDEBUG] push_ack key=%lld\n",
                       (long long)p->key);
             Record(p->key, "push", t_push);
+            // Async: the ack carries the server's fleet-wide apply count
+            // for this key as of OUR push; the pull resp carries it as
+            // of the pull. Their difference is this pull's staleness.
+            int64_t at_push = ack.head.arg1;
             // Push acknowledged -> issue the pull for the aggregate.
             MsgHeader ph{};
             ph.cmd = CMD_PULL;
@@ -250,8 +254,8 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
             int64_t t_pull = NowUs();
             kv_->Request(
                 p->server_id, ph, nullptr, 0,
-                [this, ctx, p, base, raw_len, scale, handle,
-                 t_pull](Message&& resp) {
+                [this, ctx, p, base, raw_len, scale, handle, t_pull,
+                 flags, at_push](Message&& resp) {
                   if (resp.head.cmd == CMD_ERROR) {
                     FailHandle(handle, p->key, std::move(resp));
                     queue_->ReleaseCredit(raw_len);
@@ -261,6 +265,20 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
                     fprintf(stderr, "[QDEBUG] pull_resp key=%lld\n",
                             (long long)p->key);
                   Record(p->key, "pull", t_pull);
+                  if (flags & FLAG_ASYNC) {
+                    int64_t stale = resp.head.arg1 - at_push;
+                    if (stale >= 0) {  // peers' pushes applied between
+                      stale_sum_.fetch_add(stale,
+                                           std::memory_order_relaxed);
+                      stale_n_.fetch_add(1, std::memory_order_relaxed);
+                      int64_t cur =
+                          stale_max_.load(std::memory_order_relaxed);
+                      while (stale > cur &&
+                             !stale_max_.compare_exchange_weak(
+                                 cur, stale, std::memory_order_relaxed)) {
+                      }
+                    }
+                  }
                   if (resp.head.flags & FLAG_COMPRESSED) {
                     // Pull-leg compression: the server re-encoded the
                     // aggregate with this key's codec (SURVEY.md §2.2

@@ -43,6 +43,13 @@ class BytePSWorker {
   void Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
              int64_t credit_bytes, std::string default_comp, bool trace_on);
   void Stop();
+  // Cumulative async-pull staleness stats (see stale_* members).
+  void StalenessStats(long long* sum, long long* max_out,
+                      long long* count) const {
+    *sum = stale_sum_.load(std::memory_order_relaxed);
+    *max_out = stale_max_.load(std::memory_order_relaxed);
+    *count = stale_n_.load(std::memory_order_relaxed);
+  }
   ~BytePSWorker() { Stop(); }
 
   // Partition + register a tensor with its owning servers (blocking).
@@ -119,6 +126,13 @@ class BytePSWorker {
   // Cumulative bytes assigned per server (guarded by mu_): drives the
   // byte-balanced partition->server mapping in Declare.
   std::vector<int64_t> server_bytes_;
+  // Async staleness accounting (SURVEY §2.7 DP-async): per async pull,
+  // how many fleet-wide pushes the server applied between this worker's
+  // push and its pull (from the ack/resp arg1 counters). Cumulative over
+  // the worker's lifetime; read via byteps_async_staleness.
+  std::atomic<int64_t> stale_sum_{0};
+  std::atomic<int64_t> stale_max_{0};
+  std::atomic<int64_t> stale_n_{0};
   std::unordered_map<int, std::shared_ptr<Handle>> handles_;
   int next_handle_ = 0;
   std::string last_error_;  // guarded by mu_

@@ -228,3 +228,17 @@ class Worker(_Node):
         r = ctypes.c_longlong()
         self._lib.bps_net_bytes(ctypes.byref(s), ctypes.byref(r))
         return int(s.value), int(r.value)
+
+    def async_staleness(self) -> dict:
+        """Cumulative async-pull staleness: per async pull, how many
+        fleet-wide pushes the server applied between this worker's push
+        and its pull (0 = the pull saw exactly the state this worker
+        pushed into). {mean, max, samples}; samples==0 when no async
+        pulls have completed."""
+        mean = ctypes.c_double()
+        mx = ctypes.c_longlong()
+        n = ctypes.c_longlong()
+        self._lib.bps_async_staleness(ctypes.byref(mean), ctypes.byref(mx),
+                                      ctypes.byref(n))
+        return {"mean": round(mean.value, 3), "max": int(mx.value),
+                "samples": int(n.value)}

@@ -369,6 +369,11 @@ def main() -> int:
             # after 3 pushes of ones (any interleaving), the pulled value is
             # between my 3 pushes and nw*3 total pushes
             assert arr[0] >= 3.0 - 1e-6 and arr[0] <= 3.0 * nw + 1e-6, arr[0]
+            # staleness telemetry (round 5): every async pull records how
+            # many fleet pushes landed between our push and our pull
+            st = w.async_staleness()
+            assert st["samples"] == 3, st
+            assert 0 <= st["mean"] <= st["max"] <= 3 * (nw - 1), st
 
         elif mode == "trace":
             tid = w.declare("tr", 1 << 16, "float32", compression="")
