@@ -139,11 +139,15 @@ def mode_chip(args):
     env = {"PS_HEARTBEAT_TIMEOUT": "600",
            "JAX_COMPILATION_CACHE_DIR": os.environ.get(
                "JAX_COMPILATION_CACHE_DIR", "/tmp/jaxcache")}
-    for name, extra in [
+    configs = [
         ("bf16_onebit_ef", ["--wire", "bf16", "--compressor",
                             "type=onebit;ef=vanilla"]),
         ("bf16_dense", ["--wire", "bf16"]),
-    ]:
+    ]
+    if args.codecs:
+        want = set(args.codecs.split(","))
+        configs = [(n, e) for n, e in configs if n in want]
+    for name, extra in configs:
         row = run_launcher(
             1, 1, ["--model", "gpt2_medium", "--steps", str(args.steps),
                    "--batch-size", str(args.batch),

@@ -39,6 +39,24 @@ def test_broadcast_from_root():
     run_topology(3, 2, WORKER, mode="broadcast")
 
 
+def test_pacing_rate_path():
+    """BYTEPS_PACING_RATE (kernel TCP pacing — production NIC-fair-share
+    knob and the scaling bench's link model) must leave numerics intact;
+    the rate is generous so the test costs no wall time."""
+    run_topology(2, 1, WORKER, mode="basic",
+                 extra={"BYTEPS_PACING_RATE": "1000000000"})
+
+
+def test_zerocopy_send_path():
+    """BYTEPS_VAN_ZEROCOPY=1 (MSG_ZEROCOPY experiment): the >=1 MB
+    multipart payloads take the zerocopy branch with synchronous errqueue
+    reap; sums must match exactly. Uses 1 MB partitions so at least one
+    partition clears the kZerocopyMin gate."""
+    run_topology(2, 1, WORKER, mode="multipart",
+                 extra={"BYTEPS_VAN_ZEROCOPY": "1",
+                        "BYTEPS_PARTITION_BYTES": "1048576"})
+
+
 def test_rebroadcast_delivers_fresh_values():
     run_topology(3, 2, WORKER, mode="rebroadcast")
 
