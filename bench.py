@@ -151,7 +151,31 @@ def _emit(metric, unit, bench_ips, n_dev, ratios, args, flops, per_chip):
         out["tflops_per_step"] = round(flops / 1e12, 3)
         out["mfu"] = round(
             (bench_ips / n_dev) * (flops / per_chip) / _peak_flops(), 4)
+    comm = _comm_metrics()
+    if comm:
+        out["comm_metrics"] = comm
     print(json.dumps(out))
+
+
+def _comm_metrics():
+    """Monitor-subsystem snapshot for the BENCH_* row: the DCN-leg
+    counters (wire bytes, per-stage totals, queue occupancy) so future
+    rows carry comm context next to the throughput number. Only when the
+    C core is already loaded (PS mode) — a collective-mode bench must not
+    trigger a core build just to report zeros."""
+    try:
+        import byteps_tpu.core.ffi as ffi
+        if ffi._lib is None:
+            return None
+        snap = ffi.metrics_snapshot()
+        out = {k: v for k, v in snap.get("counters", {}).items()}
+        out["van_sent_bytes"] = snap.get("van", {}).get("sent_bytes", 0)
+        out["van_recv_bytes"] = snap.get("van", {}).get("recv_bytes", 0)
+        out["queue_credit_budget_bytes"] = snap.get("queue", {}).get(
+            "credit_budget_bytes", 0)
+        return out
+    except Exception:
+        return None
 
 
 def main() -> None:

@@ -120,6 +120,81 @@ class LearningRateWarmupCallback(Callback):
             print(f"warmup lr -> {state['lr']:.6f}")
 
 
+class MonitorCallback(Callback):
+    """Publish per-step training telemetry to the live monitor endpoint
+    (byteps_tpu.monitor, docs/monitoring.md): step counter, per-step
+    throughput, wire-byte deltas, queue depth, and credit occupancy.
+    The numbers ride the same ``/metrics`` page as the C core's
+    per-stage counters, so one scrape correlates training progress with
+    communication health.
+
+    The loop provides ``state['batch_size']`` (items per global step)
+    for items/sec; without it only step timing and wire bytes are
+    published. A summary dict also lands in ``state['monitor']`` each
+    batch for in-loop consumers (loggers, progress bars)."""
+
+    def __init__(self, batch_size: Optional[int] = None):
+        self.batch_size = batch_size
+        self._last_t: Optional[float] = None
+        self._last_wire = (0, 0)
+        self._steps = 0
+
+    @staticmethod
+    def _wire_bytes() -> tuple:
+        try:
+            import byteps_tpu.core.ffi as ffi
+            if ffi._lib is None:
+                # Collective mode: no C core loaded — don't trigger a
+                # build just to report zero wire bytes.
+                return (0, 0)
+            van = ffi.metrics_snapshot().get("van", {})
+            return (int(van.get("sent_bytes", 0)),
+                    int(van.get("recv_bytes", 0)))
+        except Exception:
+            return (0, 0)
+
+    def on_train_begin(self, state):
+        import time
+        self._last_t = time.perf_counter()
+        self._last_wire = self._wire_bytes()
+
+    def on_batch_end(self, batch, state):
+        import time
+
+        from byteps_tpu.monitor import inc_counter, set_gauge
+
+        now = time.perf_counter()
+        dt = now - (self._last_t or now)
+        self._last_t = now
+        self._steps += 1
+        sent, recv = self._wire_bytes()
+        d_sent = sent - self._last_wire[0]
+        d_recv = recv - self._last_wire[1]
+        self._last_wire = (sent, recv)
+
+        inc_counter("bps_train_steps_total")
+        set_gauge("bps_step_seconds", dt)
+        set_gauge("bps_step_wire_sent_bytes", d_sent)
+        set_gauge("bps_step_wire_recv_bytes", d_recv)
+        report = {"step": self._steps, "step_seconds": dt,
+                  "wire_sent_bytes": d_sent, "wire_recv_bytes": d_recv}
+        batch_size = self.batch_size or state.get("batch_size")
+        if batch_size and dt > 0:
+            ips = batch_size / dt
+            set_gauge("bps_examples_per_sec", ips)
+            report["examples_per_sec"] = ips
+        try:
+            import byteps_tpu.core.ffi as ffi
+            if ffi._lib is not None:
+                q = ffi.metrics_snapshot().get("queue", {})
+                report["queue_pending"] = int(q.get("pending", 0))
+                report["credit_inflight_bytes"] = int(
+                    q.get("inflight_bytes", 0))
+        except Exception:
+            pass
+        state["monitor"] = report
+
+
 def warmup_schedule(base_lr: float, multiplier: Optional[float] = None,
                     warmup_steps: int = 1000):
     """optax learning-rate schedule: linear warmup from ``base_lr`` to

@@ -77,6 +77,15 @@ class Config:
     trace_start_step: int = 1             # BYTEPS_TRACE_START_STEP
     trace_end_step: int = 10              # BYTEPS_TRACE_END_STEP
 
+    # --- live monitoring (byteps_tpu.monitor, docs/monitoring.md) ----------
+    monitor_on: bool = False              # BYTEPS_MONITOR_ON
+    monitor_port: int = 9100              # BYTEPS_MONITOR_PORT (BASE port:
+    #   each node serves /metrics + /healthz on base + its node id, so one
+    #   env var covers a whole co-located fleet)
+    straggler_factor: float = 2.0         # BYTEPS_STRAGGLER_FACTOR
+    #   monitor.top flags a worker whose mean push latency exceeds
+    #   factor x the fleet's low-median (see docs/monitoring.md)
+
     # --- TPU-specific (new scope; no reference equivalent) -----------------
     ici_axis: str = "ici"                 # mesh axis name for intra-slice
     dcn_axis: str = "dcn"                 # mesh axis name for inter-slice
@@ -137,6 +146,15 @@ class Config:
             raise ValueError("DMLC_NUM_WORKER must be >= 1")
         if self.ps_mode not in ("auto", "collective", "ps"):
             raise ValueError("BYTEPS_PS_MODE must be auto|collective|ps")
+        if not (0 < self.monitor_port < 65536):
+            raise ValueError(
+                "BYTEPS_MONITOR_PORT must be in (0, 65536); it is the BASE "
+                "port — each node serves on base + its node id")
+        if self.straggler_factor < 1.0:
+            raise ValueError(
+                "BYTEPS_STRAGGLER_FACTOR must be >= 1.0 (a worker is "
+                "flagged when its mean push latency exceeds factor x the "
+                "fleet low-median)")
         return self
 
 
@@ -166,6 +184,10 @@ def load_config() -> Config:
         trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
         trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 1),
         trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 10),
+        monitor_on=_env_bool("BYTEPS_MONITOR_ON"),
+        monitor_port=_env_int("BYTEPS_MONITOR_PORT", 9100),
+        straggler_factor=float(
+            os.environ.get("BYTEPS_STRAGGLER_FACTOR", "2.0")),
         ici_axis=_env_str("BYTEPS_ICI_AXIS", "ici"),
         dcn_axis=_env_str("BYTEPS_DCN_AXIS", "dcn"),
         ps_mode=_env_str("BYTEPS_PS_MODE", "auto").lower(),

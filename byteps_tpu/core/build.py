@@ -73,7 +73,11 @@ def build(force: bool = False, verbose: bool = True,
         for extra in ("-march=native", "-fopenmp"):
             if _supports_flag(cxx, extra):
                 flags.append(extra)
-    cmd = [cxx, *flags, *srcs, "-o", lib_path]
+    # -lrt: shm_open/shm_unlink (the shm van transport) live in librt on
+    # glibc < 2.34; on newer glibc the library is an empty stub, so
+    # linking it unconditionally is safe and keeps dlopen from failing
+    # with "undefined symbol: shm_open" on older hosts.
+    cmd = [cxx, *flags, *srcs, "-o", lib_path, "-lrt"]
     if verbose:
         print("[byteps_tpu.core.build]", " ".join(cmd))
     subprocess.run(cmd, check=True)

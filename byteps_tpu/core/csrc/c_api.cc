@@ -16,6 +16,7 @@
 #include "debug.h"
 #include "kv.h"
 #include "logging.h"
+#include "metrics.h"
 #include "postoffice.h"
 #include "server.h"
 #include "worker.h"
@@ -255,41 +256,107 @@ double bps_reducer_bench(long long nbytes, int iters, int dtype) {
   return static_cast<double>(nbytes) * iters / s / 1e9;
 }
 
-// Cumulative DCN wire bytes through this node's van (frames + payloads).
-// For bandwidth assertions (e.g. both push AND pull legs shrink under
-// compression) and the timeline.
-void bps_net_bytes(long long* sent, long long* recv) {
+// One-call telemetry snapshot for the byteps_tpu.monitor subsystem:
+// the whole metric registry (counters / gauges / latency histograms
+// instrumented at every pipeline stage) plus the live node state that
+// used to be three ad-hoc C APIs — van wire bytes, async staleness,
+// scheduler dead nodes — and the scheduled-queue occupancy. Writes a
+// JSON document into `buf` (NUL-terminated, truncated if needed) and
+// returns the FULL length required excluding the NUL; callers retry
+// with a bigger buffer when the return value >= maxlen. Callable in any
+// state (before init, after finalize): sections without a live owner
+// are emptied, the registry (process-cumulative) is always present.
+long long bps_metrics_snapshot(char* buf, long long maxlen) {
   Global* gl = g();
-  *sent = gl->po ? gl->po->van().bytes_sent() : 0;
-  *recv = gl->po ? gl->po->van().bytes_recv() : 0;
+  std::string out = "{";
+  out += Metrics::Get().SnapshotJson();
+
+  Postoffice* po = gl->inited ? gl->po.get() : nullptr;
+  out += ",\"node\":{";
+  out += "\"inited\":" + std::string(gl->inited ? "true" : "false");
+  if (po) {
+    out += ",\"role\":" + std::to_string(gl->role);
+    out += ",\"id\":" + std::to_string(po->my_id());
+    out += ",\"num_workers\":" + std::to_string(po->num_workers());
+    out += ",\"num_servers\":" + std::to_string(po->num_servers());
+    if (gl->role == ROLE_WORKER) {
+      out += ",\"worker_rank\":" + std::to_string(po->my_worker_rank());
+    }
+  }
+  out += "}";
+
+  out += ",\"van\":{\"sent_bytes\":";
+  out += std::to_string(po ? po->van().bytes_sent() : 0);
+  out += ",\"recv_bytes\":";
+  out += std::to_string(po ? po->van().bytes_recv() : 0);
+  out += "}";
+
+  BytePSWorker* w = gl->inited ? gl->worker.get() : nullptr;
+  long long ssum = 0, smax = 0, scnt = 0;
+  if (w) w->StalenessStats(&ssum, &smax, &scnt);
+  char stale[128];
+  snprintf(stale, sizeof(stale),
+           ",\"staleness\":{\"mean\":%.3f,\"max\":%lld,\"samples\":%lld}",
+           scnt > 0 ? static_cast<double>(ssum) / scnt : 0.0, smax, scnt);
+  out += stale;
+
+  int64_t qp = 0, qi = 0, qb = 0;
+  if (w) w->QueueStats(&qp, &qi, &qb);
+  out += ",\"queue\":{\"pending\":" + std::to_string(qp);
+  out += ",\"inflight_bytes\":" + std::to_string(qi);
+  out += ",\"credit_budget_bytes\":" + std::to_string(qb) + "}";
+
+  out += ",\"heartbeat_age_ms\":{";
+  if (po && gl->role == ROLE_SCHEDULER) {
+    bool first = true;
+    for (const auto& kv : po->HeartbeatAges()) {
+      if (!first) out += ",";
+      first = false;
+      out += "\"" + std::to_string(kv.first) +
+             "\":" + std::to_string(kv.second);
+    }
+  }
+  out += "},\"dead_nodes\":[";
+  if (po) {
+    bool first = true;
+    for (int id : po->DeadNodes()) {
+      if (!first) out += ",";
+      first = false;
+      out += std::to_string(id);
+    }
+  }
+  out += "]}";
+
+  long long need = static_cast<long long>(out.size());
+  if (buf && maxlen > 0) {
+    long long n = need < maxlen - 1 ? need : maxlen - 1;
+    memcpy(buf, out.data(), static_cast<size_t>(n));
+    buf[n] = '\0';
+  }
+  return need;
 }
 
-// Async-mode staleness stats (cumulative): per async pull, the number of
-// fleet-wide pushes the server applied between this worker's push and
-// its pull. samples==0 means no async pulls have completed.
-void bps_async_staleness(double* mean, long long* max_, long long* n) {
-  BytePSWorker* w = g()->worker.get();
-  if (!w) {
-    *mean = 0.0;
-    *max_ = 0;
-    *n = 0;
-    return;
+// Record into the registry from outside the C core: kind is "counter"
+// (add v), "gauge" (set v) or "histo" (observe v, microseconds). Used
+// by the Python monitor layer (step-level metrics live in the same
+// registry as the C++ pipeline stages) and by the metrics unit tests
+// to exercise bucketing without a topology. Returns 0, or -1 on an
+// unknown kind.
+int bps_metrics_observe(const char* kind, const char* name, long long v) {
+  if (!kind || !name) return -1;
+  if (strcmp(kind, "counter") == 0) {
+    Metrics::Get().Counter(name)->fetch_add(v, std::memory_order_relaxed);
+    return 0;
   }
-  long long sum, cnt;
-  w->StalenessStats(&sum, max_, &cnt);
-  *n = cnt;
-  *mean = cnt > 0 ? static_cast<double>(sum) / cnt : 0.0;
-}
-
-// Scheduler-side failure detection: ids of nodes with expired heartbeats.
-int bps_dead_nodes(int* out, int max) {
-  auto dead = g()->po->DeadNodes();
-  int n = 0;
-  for (int id : dead) {
-    if (n >= max) break;
-    out[n++] = id;
+  if (strcmp(kind, "gauge") == 0) {
+    Metrics::Get().Gauge(name)->store(v, std::memory_order_relaxed);
+    return 0;
   }
-  return n;
+  if (strcmp(kind, "histo") == 0) {
+    Metrics::Get().Histogram(name)->Observe(v);
+    return 0;
+  }
+  return -1;
 }
 
 }  // extern "C"

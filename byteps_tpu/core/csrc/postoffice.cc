@@ -437,6 +437,17 @@ std::vector<int> Postoffice::DeadNodes() {
   return dead;
 }
 
+std::vector<std::pair<int, int64_t>> Postoffice::HeartbeatAges() {
+  std::vector<std::pair<int, int64_t>> ages;
+  std::lock_guard<std::mutex> lk(mu_);
+  int64_t now = NowMs();
+  for (const auto& kv : last_heartbeat_ms_) {
+    ages.emplace_back(kv.first, now - kv.second);
+  }
+  std::sort(ages.begin(), ages.end());
+  return ages;
+}
+
 void Postoffice::Finalize() {
   if (!van_) return;
   if (shutting_down_.load()) {

@@ -86,6 +86,10 @@ class Postoffice {
   bool ShuttingDown() const { return shutting_down_.load(); }
   // Worker/server ids the scheduler considers dead (missed heartbeats).
   std::vector<int> DeadNodes();
+  // Scheduler-side heartbeat freshness: (node id, ms since last beat)
+  // for every tracked node, sorted by id — the monitor snapshot's
+  // health signal (a cleanly-departed node is not tracked).
+  std::vector<std::pair<int, int64_t>> HeartbeatAges();
 
  private:
   void ControlHandler(Message&& msg, int fd);

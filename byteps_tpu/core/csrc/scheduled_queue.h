@@ -130,6 +130,16 @@ class ScheduledQueue {
     return heap_.size();
   }
 
+  // Live occupancy for the monitor snapshot (bps_metrics_snapshot):
+  // queue depth + credit window let an operator see whether the push
+  // stage is admission-bound (inflight pinned at budget, deep queue) or
+  // starved (both near zero).
+  int64_t inflight_bytes() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return inflight_bytes_;
+  }
+  int64_t budget_bytes() const { return budget_; }
+
  private:
   std::mutex mu_;
   std::condition_variable cv_;

@@ -4,12 +4,24 @@
 
 #include "cpu_reducer.h"
 #include "logging.h"
+#include "metrics.h"
+#include "worker.h"  // NowUs
 
 namespace bps {
 
 void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
   po_ = po;
   async_ = async_mode;
+  // Pre-register the server-side metric catalog so every /metrics page
+  // serves the full series from zero — an idle server (no key routed to
+  // it yet) must still expose bps_recv_bytes_total for the fleet-wide
+  // parity sum (docs/monitoring.md), not omit the series.
+  Metrics::Get().Counter("bps_recv_bytes_total");
+  Metrics::Get().Counter("bps_server_push_total");
+  Metrics::Get().Counter("bps_server_pull_total");
+  Metrics::Get().Counter("bps_server_reply_bytes_total");
+  Metrics::Get().Counter("bps_server_sum_bytes_total");
+  Metrics::Get().Histogram("bps_server_sum_us");
   queues_.clear();
   for (int i = 0; i < engine_threads; ++i) {
     queues_.push_back(std::make_unique<EngineQueue>());
@@ -22,6 +34,16 @@ void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
 }
 
 void BytePSServer::Handle(Message&& msg, int fd) {
+  // Wire accounting here, NOT in Process(): parked pushes replay through
+  // Process (ReplayParked), and counting a replay again would break the
+  // push-bytes parity contract with the workers (docs/monitoring.md).
+  if (msg.head.cmd == CMD_PUSH) {
+    BPS_METRIC_COUNTER_ADD("bps_recv_bytes_total",
+                           static_cast<int64_t>(msg.payload.size()));
+    BPS_METRIC_COUNTER_ADD("bps_server_push_total", 1);
+  } else if (msg.head.cmd == CMD_PULL) {
+    BPS_METRIC_COUNTER_ADD("bps_server_pull_total", 1);
+  }
   // Route by key so one key's operations are totally ordered on one thread.
   size_t tid = static_cast<size_t>(msg.head.key) % queues_.size();
   auto& eq = *queues_[tid];
@@ -130,7 +152,10 @@ void BytePSServer::Process(Message&& msg, int fd) {
           ks->param.assign(data, data + data_len);
           ks->param_init = true;
         } else {
+          int64_t t_sum = NowUs();
           CpuReducer::Sum(ks->param.data(), data, data_len, ks->dtype);
+          BPS_METRIC_HISTO_OBSERVE("bps_server_sum_us", NowUs() - t_sum);
+          BPS_METRIC_COUNTER_ADD("bps_server_sum_bytes_total", data_len);
         }
         // Fleet-wide apply counter for this key: carried back on the ack
         // (and on async pull responses), so workers can measure the
@@ -144,7 +169,10 @@ void BytePSServer::Process(Message&& msg, int fd) {
           ks->round[slot] = h.version;
           ks->slot[slot].assign(data, data + data_len);
         } else {
+          int64_t t_sum = NowUs();
           CpuReducer::Sum(ks->slot[slot].data(), data, data_len, ks->dtype);
+          BPS_METRIC_HISTO_OBSERVE("bps_server_sum_us", NowUs() - t_sum);
+          BPS_METRIC_COUNTER_ADD("bps_server_sum_bytes_total", data_len);
         }
         if (++ks->push_count[slot] == po_->num_workers()) {
           ks->ready[slot] = true;
@@ -196,6 +224,8 @@ void BytePSServer::Process(Message&& msg, int fd) {
         resp.dtype = ks->dtype;
         resp.arg1 = ks->async_pushes;
         BPS_CHECK(ks->param_init) << "async pull before any push " << h.key;
+        BPS_METRIC_COUNTER_ADD("bps_server_reply_bytes_total",
+                               static_cast<int64_t>(ks->param.size()));
         po_->van().Send(fd, resp, ks->param.data(), ks->param.size());
       } else {
         int slot = h.version & 1;
@@ -281,9 +311,14 @@ bool BytePSServer::ReplyPull(KeyStore* ks, int slot, int fd,
   if (ks->reply_comp && !ks->comp_reply[slot].empty()) {
     resp.flags = FLAG_COMPRESSED;
     resp.arg0 = ks->len;  // decompressed size, for the worker's check
+    BPS_METRIC_COUNTER_ADD(
+        "bps_server_reply_bytes_total",
+        static_cast<int64_t>(ks->comp_reply[slot].size()));
     po_->van().Send(fd, resp, ks->comp_reply[slot].data(),
                     ks->comp_reply[slot].size());
   } else {
+    BPS_METRIC_COUNTER_ADD("bps_server_reply_bytes_total",
+                           static_cast<int64_t>(ks->slot[slot].size()));
     po_->van().Send(fd, resp, ks->slot[slot].data(), ks->slot[slot].size());
   }
   if (++ks->pull_count[slot] == po_->num_workers()) {

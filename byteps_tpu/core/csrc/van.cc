@@ -25,6 +25,7 @@
 #include <new>
 
 #include "logging.h"
+#include "metrics.h"
 #include "shm_ring.h"
 
 namespace bps {
@@ -368,6 +369,7 @@ bool Van::Send(int fd, const MsgHeader& head, const void* payload,
   // Under the per-fd send lock so the PS_VERBOSE trace order matches the
   // actual wire order (the whole point of a message trace).
   LogMsg("send", fd, h, payload_len);
+  BPS_METRIC_COUNTER_ADD("bps_van_sent_frames_total", 1);
   if (shm) {
     // Ring data path: same frame layout, memcpy instead of syscalls. The
     // per-fd send lock makes this the ring's single producer.
@@ -525,6 +527,7 @@ void Van::DispatchFrame(Message&& msg, int fd) {
   bytes_recv_.fetch_add(
       static_cast<int64_t>(sizeof(uint64_t) + sizeof(MsgHeader) + plen),
       std::memory_order_relaxed);
+  BPS_METRIC_COUNTER_ADD("bps_van_recv_frames_total", 1);
   LogMsg("recv", fd, msg.head, plen);
   if (msg.head.cmd == CMD_SHM_HELLO) {
     // Van-internal: the peer created a shm segment for this connection.

@@ -5,6 +5,7 @@
 
 #include "cpu_reducer.h"
 #include "logging.h"
+#include "metrics.h"
 
 namespace bps {
 
@@ -22,6 +23,16 @@ void BytePSWorker::Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
   partition_bytes_ = partition_bytes;
   default_comp_ = std::move(default_comp);
   trace_on_ = trace_on;
+  // Pre-register the worker-side metric catalog: every stage's series
+  // exists from zero on the /metrics page (an idle or compression-less
+  // worker omits nothing — scrapers sum and ratio these fleet-wide).
+  Metrics::Get().Counter("bps_partitions_enqueued_total");
+  Metrics::Get().Counter("bps_enqueued_bytes_total");
+  Metrics::Get().Counter("bps_push_bytes_total");
+  Metrics::Get().Counter("bps_push_partitions_total");
+  Metrics::Get().Counter("bps_pull_bytes_total");
+  Metrics::Get().Histogram("bps_push_us");
+  Metrics::Get().Histogram("bps_pull_us");
   // Reference semantics: BYTEPS_SCHEDULING_CREDIT is an in-flight BYTE
   // budget. 0 = auto: four full partitions' worth. A value under 1024
   // can only be a legacy partition count (the reference default was 4;
@@ -216,6 +227,9 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
         payload_len = static_cast<int64_t>(p->comp_buf.size());
         flags |= FLAG_COMPRESSED;
         Record(p->key, "compress", t0);
+        BPS_METRIC_HISTO_OBSERVE("bps_compress_us", NowUs() - t0);
+        BPS_METRIC_COUNTER_ADD("bps_compress_in_bytes_total", raw_len);
+        BPS_METRIC_COUNTER_ADD("bps_compress_out_bytes_total", payload_len);
       }
       MsgHeader h{};
       h.cmd = CMD_PUSH;
@@ -225,6 +239,12 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
       h.flags = flags;
       h.arg0 = raw_len;
       int64_t t_push = NowUs();
+      // Wire-byte parity contract with the server's bps_recv_bytes_total
+      // (docs/monitoring.md): both sides count CMD_PUSH payload bytes —
+      // compressed size when a codec is on — so worker-side push totals
+      // and server-side recv totals sum to the same number fleet-wide.
+      BPS_METRIC_COUNTER_ADD("bps_push_bytes_total", payload_len);
+      BPS_METRIC_COUNTER_ADD("bps_push_partitions_total", 1);
       kv_->Request(
           p->server_id, h, payload, payload_len,
           [this, ctx, p, base, raw_len, version, scale, flags, handle,
@@ -240,6 +260,7 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
               fprintf(stderr, "[QDEBUG] push_ack key=%lld\n",
                       (long long)p->key);
             Record(p->key, "push", t_push);
+            BPS_METRIC_HISTO_OBSERVE("bps_push_us", NowUs() - t_push);
             // Async: the ack carries the server's fleet-wide apply count
             // for this key as of OUR push; the pull resp carries it as
             // of the pull. Their difference is this pull's staleness.
@@ -265,6 +286,10 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
                     fprintf(stderr, "[QDEBUG] pull_resp key=%lld\n",
                             (long long)p->key);
                   Record(p->key, "pull", t_pull);
+                  BPS_METRIC_HISTO_OBSERVE("bps_pull_us", NowUs() - t_pull);
+                  BPS_METRIC_COUNTER_ADD(
+                      "bps_pull_bytes_total",
+                      static_cast<int64_t>(resp.payload.size()));
                   if (flags & FLAG_ASYNC) {
                     int64_t stale = resp.head.arg1 - at_push;
                     if (stale >= 0) {  // peers' pushes applied between
@@ -288,10 +313,13 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
                         << "compressed pull but no codec, key " << p->key;
                     BPS_CHECK_EQ(resp.head.arg0, raw_len)
                         << "pull length mismatch for key " << p->key;
+                    int64_t t_dec = NowUs();
                     p->comp->Decompress(
                         resp.payload.data(),
                         static_cast<int64_t>(resp.payload.size()),
                         reinterpret_cast<float*>(base), p->len);
+                    BPS_METRIC_HISTO_OBSERVE("bps_decompress_us",
+                                             NowUs() - t_dec);
                   } else {
                     BPS_CHECK_EQ(
                         static_cast<int64_t>(resp.payload.size()), raw_len)
@@ -309,6 +337,8 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
                 });
           });
     };
+    BPS_METRIC_COUNTER_ADD("bps_partitions_enqueued_total", 1);
+    BPS_METRIC_COUNTER_ADD("bps_enqueued_bytes_total", task.bytes);
     queue_->Push(std::move(task));
   }
   return handle_id;

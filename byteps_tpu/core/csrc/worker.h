@@ -79,6 +79,19 @@ class BytePSWorker {
 
   std::vector<TraceEvent> DrainTrace();
 
+  // Scheduled-queue occupancy for the monitor snapshot: pending tasks,
+  // in-flight bytes, and the credit budget they are admitted against.
+  void QueueStats(int64_t* pending, int64_t* inflight,
+                  int64_t* budget) const {
+    if (!queue_) {
+      *pending = *inflight = *budget = 0;
+      return;
+    }
+    *pending = static_cast<int64_t>(queue_->pending());
+    *inflight = queue_->inflight_bytes();
+    *budget = queue_->budget_bytes();
+  }
+
  private:
   struct Part {
     int64_t key;

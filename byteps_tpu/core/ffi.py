@@ -75,15 +75,46 @@ def _load() -> ctypes.CDLL:
     lib.bps_poll.restype = ctypes.c_int
     lib.bps_dump_trace.argtypes = [ctypes.c_char_p]
     lib.bps_dump_trace.restype = ctypes.c_int
-    lib.bps_net_bytes.argtypes = [ctypes.POINTER(ctypes.c_longlong),
-                                  ctypes.POINTER(ctypes.c_longlong)]
     lib.bps_reducer_bench.argtypes = [ctypes.c_longlong, ctypes.c_int,
                                       ctypes.c_int]
     lib.bps_reducer_bench.restype = ctypes.c_double
-    lib.bps_dead_nodes.argtypes = [ctypes.POINTER(ctypes.c_int), ctypes.c_int]
-    lib.bps_dead_nodes.restype = ctypes.c_int
+    # One telemetry surface (byteps_tpu.monitor): the snapshot absorbs
+    # the former bps_net_bytes / bps_async_staleness / bps_dead_nodes
+    # ad-hoc diagnostics — net_bytes()/async_staleness()/dead_nodes()
+    # below are now views over it.
+    lib.bps_metrics_snapshot.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+    lib.bps_metrics_snapshot.restype = ctypes.c_longlong
+    lib.bps_metrics_observe.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                        ctypes.c_longlong]
+    lib.bps_metrics_observe.restype = ctypes.c_int
     _lib = lib
     return lib
+
+
+def metrics_snapshot() -> dict:
+    """Parse the C core's one-call telemetry snapshot (counters, gauges,
+    latency histograms, van wire bytes, async staleness, queue occupancy,
+    scheduler heartbeat ages / dead nodes) into a dict. Works in any
+    process state; pre-init sections come back empty."""
+    import json
+
+    lib = _load()
+    size = 1 << 16
+    while True:
+        buf = ctypes.create_string_buffer(size)
+        need = int(lib.bps_metrics_snapshot(buf, size))
+        if need < size:
+            return json.loads(buf.value.decode())
+        size = need + 1
+
+
+def metrics_observe(kind: str, name: str, value: int) -> None:
+    """Record into the core metric registry from Python ("counter" adds,
+    "gauge" sets, "histo" observes microseconds)."""
+    rc = _load().bps_metrics_observe(kind.encode(), name.encode(),
+                                     int(value))
+    if rc != 0:
+        raise ValueError(f"unknown metric kind {kind!r}")
 
 
 def reducer_bench(nbytes: int = 64 << 20, iters: int = 20,
@@ -116,6 +147,8 @@ def _apply_config_env(cfg: Optional[Config]) -> None:
     if cfg.compressor:
         os.environ["BYTEPS_COMPRESSOR"] = cfg.compressor
     os.environ["BYTEPS_TRACE_ON"] = "1" if cfg.trace_on else "0"
+    os.environ["BYTEPS_MONITOR_ON"] = "1" if cfg.monitor_on else "0"
+    os.environ["BYTEPS_MONITOR_PORT"] = str(cfg.monitor_port)
 
 
 class _Node:
@@ -128,6 +161,11 @@ class _Node:
         if self.node_id < 0:
             raise RuntimeError("bps_init failed")
         self._alive = True
+        # Live observability endpoint (/metrics + /healthz) when
+        # BYTEPS_MONITOR_ON — every role serves one, on the monitor base
+        # port + this node's id (docs/monitoring.md).
+        from byteps_tpu.monitor import maybe_start_monitor
+        self._monitor = maybe_start_monitor(self.node_id)
 
     @classmethod
     def start(cls, cfg: Optional[Config] = None):
@@ -135,20 +173,32 @@ class _Node:
 
     def shutdown(self) -> None:
         if self._alive:
+            # Monitor stops AFTER finalize: for scheduler/server roles
+            # shutdown() IS the serving loop (run = shutdown; Finalize
+            # blocks for the fleet's whole life), and the endpoint must
+            # be scrapable exactly then. Scrapes racing the finalize
+            # tail are safe — the postoffice object outlives finalize
+            # (it is only destroyed by a later re-init) and the snapshot
+            # guards every section on the inited flag.
             self._lib.bps_finalize()
             self._alive = False
+            if self._monitor is not None:
+                self._monitor.stop()
+                self._monitor = None
 
     # Scheduler/Server block here until the fleet shuts down.
     run = shutdown
+
+    def metrics_snapshot(self) -> dict:
+        """Full telemetry snapshot for this node (see metrics_snapshot)."""
+        return metrics_snapshot()
 
 
 class Scheduler(_Node):
     ROLE = 0
 
     def dead_nodes(self, max_nodes: int = 64) -> list:
-        buf = (ctypes.c_int * max_nodes)()
-        n = self._lib.bps_dead_nodes(buf, max_nodes)
-        return list(buf[:n])
+        return metrics_snapshot()["dead_nodes"][:max_nodes]
 
 
 class Server(_Node):
@@ -224,10 +274,8 @@ class Worker(_Node):
     def net_bytes(self) -> tuple:
         """Cumulative (sent, received) DCN wire bytes through this
         worker's van — for bandwidth assertions and the timeline."""
-        s = ctypes.c_longlong()
-        r = ctypes.c_longlong()
-        self._lib.bps_net_bytes(ctypes.byref(s), ctypes.byref(r))
-        return int(s.value), int(r.value)
+        van = metrics_snapshot()["van"]
+        return int(van["sent_bytes"]), int(van["recv_bytes"])
 
     def async_staleness(self) -> dict:
         """Cumulative async-pull staleness: per async pull, how many
@@ -235,10 +283,6 @@ class Worker(_Node):
         and its pull (0 = the pull saw exactly the state this worker
         pushed into). {mean, max, samples}; samples==0 when no async
         pulls have completed."""
-        mean = ctypes.c_double()
-        mx = ctypes.c_longlong()
-        n = ctypes.c_longlong()
-        self._lib.bps_async_staleness(ctypes.byref(mean), ctypes.byref(mx),
-                                      ctypes.byref(n))
-        return {"mean": round(mean.value, 3), "max": int(mx.value),
-                "samples": int(n.value)}
+        st = metrics_snapshot()["staleness"]
+        return {"mean": round(float(st["mean"]), 3),
+                "max": int(st["max"]), "samples": int(st["samples"])}

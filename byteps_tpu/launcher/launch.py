@@ -164,6 +164,17 @@ def launch_local_fleet(command: Sequence[str], num_workers: int,
         "DMLC_NUM_SERVER": str(num_servers),
         "BYTEPS_FORCE_DISTRIBUTED": "1",
     })
+    if base.get("BYTEPS_MONITOR_ON", "").strip().lower() in (
+            "1", "true", "yes", "on"):
+        # Every role serves /metrics + /healthz on base_port + node_id
+        # (byteps_tpu.monitor); print the map so the operator can point
+        # `python -m byteps_tpu.monitor.top` (or curl) at the fleet.
+        mport = int(base.get("BYTEPS_MONITOR_PORT", "9100") or 9100)
+        from byteps_tpu.monitor.top import fleet_endpoints
+        eps = fleet_endpoints("127.0.0.1", mport, num_workers, num_servers)
+        print("bpslaunch: monitor endpoints: "
+              + " ".join(f"{n}=http://{e}" for n, e in sorted(eps.items())),
+              file=sys.stderr)
     server_cmd = [sys.executable, "-m", "byteps_tpu.server"]
     auto_port = port == 0
     for attempt in range(3):
@@ -214,6 +225,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "1: one controller drives all local chips)")
     p.add_argument("--numa", action="store_true",
                    help="bind worker processes round-robin across NUMA nodes")
+    p.add_argument("--monitor-port", type=int, metavar="BASE", default=0,
+                   help="enable live monitoring (BYTEPS_MONITOR_ON=1): "
+                        "every role serves /metrics + /healthz on "
+                        "BASE + its node id; scrape with "
+                        "`python -m byteps_tpu.monitor.top`")
     p.add_argument("--restarts", type=int, default=0,
                    help="--local mode: relaunch the whole fleet up to N "
                         "times after a failed run (elastic-ish recovery: "
@@ -225,6 +241,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     command = list(args.command)
     if command and command[0] == "--":
         command = command[1:]
+    if args.monitor_port:
+        os.environ["BYTEPS_MONITOR_ON"] = "1"
+        os.environ["BYTEPS_MONITOR_PORT"] = str(args.monitor_port)
 
     if args.local:
         if not command:

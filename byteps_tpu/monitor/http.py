@@ -1,0 +1,104 @@
+"""Background-thread HTTP endpoint serving /metrics and /healthz.
+
+Every role (worker, server, scheduler) starts one automatically when
+``BYTEPS_MONITOR_ON=1``; the port is ``BYTEPS_MONITOR_PORT + node_id``
+(scheduler 0, servers 1..S, workers S+1..S+W — postoffice.h id layout),
+so one env var covers a co-located fleet and ``monitor.top`` can derive
+every endpoint from the topology alone.
+
+The endpoint must never take the job down: bind failures log a warning
+and disable monitoring for this process; request handling errors return
+500 to the scraper and nothing to the training loop.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+from typing import Optional
+
+from byteps_tpu.monitor import metrics as _metrics
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    server_version = "byteps-monitor/1.0"
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        try:
+            if self.path.split("?")[0] == "/metrics":
+                body = _metrics.prometheus_text().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                code = 200
+            elif self.path.split("?")[0] == "/healthz":
+                snap = _metrics.snapshot()
+                dead = snap.get("dead_nodes", [])
+                node = snap.get("node", {})
+                healthy = bool(node.get("inited")) and not dead
+                body = json.dumps({
+                    "status": "ok" if healthy else "degraded",
+                    "inited": bool(node.get("inited")),
+                    "role": node.get("role"),
+                    "node_id": node.get("id"),
+                    "dead_nodes": dead,
+                    "uptime_s": round(
+                        time.monotonic() - self.server.started_at, 3),
+                }).encode()
+                ctype = "application/json"
+                code = 200 if healthy else 503
+            else:
+                body, ctype, code = b"not found\n", "text/plain", 404
+        except Exception as e:  # scrape must not kill the job
+            body = f"snapshot failed: {e}\n".encode()
+            ctype, code = "text/plain", 500
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # silence per-request stderr noise
+        pass
+
+
+class MonitorServer:
+    """ThreadingHTTPServer on a daemon thread; stop() joins it."""
+
+    def __init__(self, port: int, host: str = "0.0.0.0"):
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                     _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.started_at = time.monotonic()
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="bps-monitor",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def maybe_start_monitor(node_id: int) -> Optional[MonitorServer]:
+    """Start the endpoint for this node iff BYTEPS_MONITOR_ON; returns
+    None (monitoring off or port taken) otherwise. Never raises — the
+    monitor is best-effort by contract."""
+    import logging
+
+    from byteps_tpu.config import load_config
+
+    try:
+        cfg = load_config()
+        if not cfg.monitor_on:
+            return None
+        srv = MonitorServer(cfg.monitor_port + node_id)
+        logging.getLogger("byteps_tpu.monitor").info(
+            "monitor endpoint on :%d (/metrics, /healthz)", srv.port)
+        return srv
+    except Exception as e:
+        logging.getLogger("byteps_tpu.monitor").warning(
+            "monitor endpoint disabled: %s", e)
+        return None

@@ -1,0 +1,186 @@
+"""Fleet scraper + straggler/health detector.
+
+``python -m byteps_tpu.monitor.top`` polls every role's monitor endpoint
+(derived from the topology env — DMLC_NUM_WORKER / DMLC_NUM_SERVER /
+BYTEPS_MONITOR_PORT — or given explicitly with ``--endpoints``) and
+reports, per worker: push throughput, wire bytes, queue occupancy, and
+mean push latency; fleet-wide: heartbeat freshness and dead nodes.
+
+Straggler rule (docs/monitoring.md): a worker is flagged when its mean
+push latency exceeds ``BYTEPS_STRAGGLER_FACTOR`` (default 2.0) times the
+fleet's LOW-median of worker means, and is above an absolute 1 ms floor.
+The low-median (lower of the two middle values) keeps the baseline
+anchored to the healthy majority even in 2-worker fleets, where a plain
+median would average the straggler in. Heartbeat health comes from the
+scheduler endpoint: an age past PS_HEARTBEAT_TIMEOUT is stale; ids in
+``bps_dead_nodes`` are already declared dead.
+
+The launcher and later fault-tolerance PRs consume the same ``analyze``
+output programmatically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_TIMEOUT_S = 2.0
+
+
+def fleet_endpoints(host: str, base_port: int, num_workers: int,
+                    num_servers: int) -> Dict[str, str]:
+    """role-name -> host:port for every node, from the postoffice id
+    layout (scheduler 0, servers 1..S, workers S+1..S+W)."""
+    eps = {"scheduler": f"{host}:{base_port}"}
+    for s in range(num_servers):
+        eps[f"server{s}"] = f"{host}:{base_port + 1 + s}"
+    for w in range(num_workers):
+        eps[f"worker{w}"] = f"{host}:{base_port + 1 + num_servers + w}"
+    return eps
+
+
+def scrape(endpoint: str, timeout: float = DEFAULT_TIMEOUT_S
+           ) -> Optional[dict]:
+    """Fetch + parse one endpoint's /metrics; None when unreachable."""
+    from byteps_tpu.monitor.metrics import parse_prometheus
+    try:
+        with urllib.request.urlopen(f"http://{endpoint}/metrics",
+                                    timeout=timeout) as r:
+            return parse_prometheus(r.read().decode())
+    except (OSError, ValueError):
+        return None
+
+
+def _sample(metrics: dict, name: str, default: float = 0.0) -> float:
+    series = metrics.get(name)
+    if not series:
+        return default
+    return next(iter(series.values()))
+
+
+def analyze(scrapes: Dict[str, Optional[dict]],
+            straggler_factor: float = 2.0,
+            heartbeat_timeout_s: float = 30.0) -> dict:
+    """Turn per-role scrapes into a health report. ``scrapes`` maps role
+    names (workerN / serverN / scheduler) to parsed metrics (None =
+    endpoint unreachable)."""
+    workers: Dict[str, dict] = {}
+    for name, m in scrapes.items():
+        if not name.startswith("worker") or m is None:
+            continue
+        count = _sample(m, "bps_push_us_count")
+        workers[name] = {
+            "push_mean_us": (_sample(m, "bps_push_us_sum") / count
+                             if count else 0.0),
+            "push_count": int(count),
+            "push_bytes": int(_sample(m, "bps_push_bytes_total")),
+            "pull_bytes": int(_sample(m, "bps_pull_bytes_total")),
+            "queue_pending": int(_sample(m, "bps_queue_pending")),
+            "inflight_bytes": int(_sample(m, "bps_queue_inflight_bytes")),
+            "credit_budget_bytes": int(
+                _sample(m, "bps_queue_credit_budget_bytes")),
+        }
+
+    stragglers: List[str] = []
+    active = {n: w["push_mean_us"] for n, w in workers.items()
+              if w["push_count"] > 0}
+    baseline_us = statistics.median_low(list(active.values())) \
+        if active else 0.0
+    for name, mean_us in active.items():
+        if mean_us >= 1000.0 and mean_us > straggler_factor * baseline_us:
+            stragglers.append(name)
+
+    stale_nodes: List[int] = []
+    dead_nodes: List[int] = []
+    sched = scrapes.get("scheduler")
+    if sched:
+        for labels in sched.get("bps_node_dead", {}):
+            dead_nodes.append(int(dict(labels)["node"]))
+        for labels, age_ms in sched.get("bps_heartbeat_age_ms",
+                                        {}).items():
+            if age_ms > heartbeat_timeout_s * 1000.0:
+                stale_nodes.append(int(dict(labels)["node"]))
+
+    return {
+        "workers": workers,
+        "baseline_push_us": baseline_us,
+        "stragglers": sorted(stragglers),
+        "stale_nodes": sorted(stale_nodes),
+        "dead_nodes": sorted(dead_nodes),
+        "unreachable": sorted(n for n, m in scrapes.items() if m is None),
+    }
+
+
+def _print_report(report: dict, as_json: bool) -> None:
+    if as_json:
+        print(json.dumps(report))
+        return
+    print(f"{'worker':<10} {'push/s':>8} {'push MB':>9} {'pull MB':>9} "
+          f"{'mean push':>10} {'queue':>6} {'credit':>14} flags")
+    for name in sorted(report["workers"]):
+        w = report["workers"][name]
+        flags = "STRAGGLER" if name in report["stragglers"] else ""
+        credit = (f"{w['inflight_bytes'] >> 10}/"
+                  f"{w['credit_budget_bytes'] >> 10}K")
+        print(f"{name:<10} {w['push_count']:>8} "
+              f"{w['push_bytes'] / 1e6:>9.2f} {w['pull_bytes'] / 1e6:>9.2f} "
+              f"{w['push_mean_us'] / 1e3:>8.2f}ms {w['queue_pending']:>6} "
+              f"{credit:>14} {flags}")
+    for kind in ("stale_nodes", "dead_nodes", "unreachable"):
+        if report[kind]:
+            print(f"{kind}: {report[kind]}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m byteps_tpu.monitor.top",
+        description="scrape the fleet's monitor endpoints; flag "
+                    "stragglers and dead/stale nodes (docs/monitoring.md)")
+    p.add_argument("--host", default=os.environ.get("DMLC_PS_ROOT_URI",
+                                                    "127.0.0.1"))
+    p.add_argument("--base-port", type=int,
+                   default=int(os.environ.get("BYTEPS_MONITOR_PORT",
+                                              "9100")))
+    p.add_argument("--num-workers", type=int,
+                   default=int(os.environ.get("DMLC_NUM_WORKER", "1")))
+    p.add_argument("--num-servers", type=int,
+                   default=int(os.environ.get("DMLC_NUM_SERVER", "1")))
+    p.add_argument("--endpoints", nargs="*", metavar="NAME=HOST:PORT",
+                   help="explicit endpoints (e.g. worker0=10.0.0.5:9104); "
+                        "overrides the derived topology")
+    p.add_argument("--straggler-factor", type=float,
+                   default=float(os.environ.get("BYTEPS_STRAGGLER_FACTOR",
+                                                "2.0")))
+    p.add_argument("--heartbeat-timeout", type=float,
+                   default=float(os.environ.get("PS_HEARTBEAT_TIMEOUT",
+                                                "30")))
+    p.add_argument("--watch", type=float, metavar="SECONDS", default=0,
+                   help="re-scrape every N seconds until interrupted")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report (one JSON line per poll)")
+    args = p.parse_args(argv)
+
+    if args.endpoints:
+        eps = dict(e.split("=", 1) for e in args.endpoints)
+    else:
+        eps = fleet_endpoints(args.host, args.base_port, args.num_workers,
+                              args.num_servers)
+    while True:
+        report = analyze({name: scrape(ep) for name, ep in eps.items()},
+                         straggler_factor=args.straggler_factor,
+                         heartbeat_timeout_s=args.heartbeat_timeout)
+        _print_report(report, args.json)
+        if not args.watch:
+            return 1 if (report["stragglers"] or report["dead_nodes"]
+                         or report["stale_nodes"]) else 0
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,7 @@ side-effects that block are hostile to tooling, so main() is a function.
 from __future__ import annotations
 
 import os
+import sys
 
 
 def main() -> None:
@@ -21,6 +22,13 @@ def main() -> None:
         node = Server.start()
     else:
         raise SystemExit(f"DMLC_ROLE must be scheduler|server, got {role!r}")
+    # BYTEPS_MONITOR_ON=1 gave this node a /metrics + /healthz endpoint
+    # (byteps_tpu.monitor, started inside Node.start); announce it so
+    # operators and monitor.top know where to scrape this role.
+    if node._monitor is not None:
+        print(f"byteps_tpu.server: {role} monitor endpoint on "
+              f":{node._monitor.port} (/metrics, /healthz)",
+              file=sys.stderr, flush=True)
     # Start() returns once the topology is up; shutdown() blocks until the
     # scheduler broadcasts fleet shutdown (worker goodbyes all received).
     node.shutdown()

@@ -425,6 +425,85 @@ def main() -> int:
                 assert "node" in str(e), e
                 print(f"fast-fail OK in {dt:.2f}s: {e}", flush=True)
 
+        elif mode == "monitor":
+            # Live-telemetry acceptance (docs/monitoring.md): after a
+            # fleet-wide push_pull, every role's /metrics endpoint must
+            # serve Prometheus-parseable text whose worker-side
+            # bps_push_bytes_total sum equals the server-side
+            # bps_recv_bytes_total sum exactly (both sides count CMD_PUSH
+            # payload bytes).
+            import json
+            import urllib.request
+
+            from byteps_tpu.monitor.metrics import parse_prometheus
+
+            base = int(os.environ["BYTEPS_MONITOR_PORT"])
+            ns = int(os.environ["DMLC_NUM_SERVER"])
+            n = 50_000
+            tid = w.declare("mon", n, "float32", compression="")
+            arr = np.full(n, float(rank + 1), np.float32)
+            h = w.push_pull(tid, arr, average=False)
+            w.wait(h)
+            np.testing.assert_allclose(arr, sum(r + 1 for r in range(nw)))
+            # All workers' pulls completed -> every server's push/reply
+            # counters are final before anyone scrapes.
+            w.barrier(GROUP_WORKERS)
+
+            def scrape(port):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=5) as r:
+                    return parse_prometheus(r.read().decode())
+
+            my_port = base + 1 + ns + rank
+            own = scrape(my_port)
+            assert own["bps_push_bytes_total"][()] == n * 4, own[
+                "bps_push_bytes_total"]
+            assert own["bps_up"][(("role", "worker"),
+                                  ("node_id", str(1 + ns + rank)))] == 1
+            # The push latency histogram saw exactly this worker's
+            # partitions, and its +Inf bucket equals its count.
+            n_parts = own["bps_push_partitions_total"][()]
+            assert own["bps_push_us_count"][()] == n_parts > 0
+            inf_key = (("le", "+Inf"),)
+            assert own["bps_push_us_bucket"][inf_key] == n_parts
+            if rank == 0:
+                worker_push = sum(
+                    scrape(base + 1 + ns + r)["bps_push_bytes_total"][()]
+                    for r in range(nw))
+                server_recv = sum(
+                    scrape(base + 1 + s)["bps_recv_bytes_total"][()]
+                    for s in range(ns))
+                assert worker_push == server_recv == nw * n * 4, (
+                    worker_push, server_recv)
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{my_port}/healthz",
+                        timeout=5) as r:
+                    health = json.loads(r.read().decode())
+                assert r.status == 200 and health["status"] == "ok", health
+            # Hold the fleet (and its endpoints) until rank 0 finished
+            # scraping everyone.
+            w.barrier(GROUP_WORKERS)
+
+        elif mode == "monitor_hold":
+            # Straggler-detection harness: MB-scale rounds (the parent
+            # pacing-limits one worker's sends so its push latency
+            # genuinely inflates), then hold the fleet alive until the
+            # parent's monitor.top scrape is done (go-file handshake).
+            import time
+            n = 1 << 18  # 1 MB float32, one partition
+            tid = w.declare("hold", n, "float32", compression="")
+            for _ in range(3):
+                arr = np.ones(n, np.float32)
+                h = w.push_pull(tid, arr, average=False)
+                w.wait(h)
+                np.testing.assert_allclose(arr, float(nw))
+            print("ready", flush=True)
+            go = os.environ.get("BPS_TEST_GO_FILE", "")
+            deadline = time.time() + 60
+            while go and not os.path.exists(go) and time.time() < deadline:
+                time.sleep(0.2)
+
         elif mode == "barrier":
             w.barrier(GROUP_WORKERS)
             print(f"rank {rank} passed barrier")

@@ -1,0 +1,316 @@
+"""byteps_tpu.monitor subsystem tests.
+
+Fast tier: C-registry histogram bucketing through the real
+bps_metrics_snapshot FFI, Prometheus exposition format, the /metrics +
+/healthz HTTP endpoint, and monitor.top's straggler/health analysis on
+synthetic scrapes.
+
+Slow (ps) tier: real 2-worker/2-server topology where worker- and
+server-side wire-byte totals must agree through /metrics, and a real
+pacing-throttled worker that monitor.top must flag as a straggler.
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from byteps_tpu.monitor import metrics as mon_metrics
+from byteps_tpu.monitor.http import MonitorServer
+from byteps_tpu.monitor.top import analyze, fleet_endpoints
+from tests.ps_utils import free_port, run_topology, spawn_role, \
+    spawn_worker, topology_env
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_ps_worker.py")
+
+
+# --- C registry via FFI (no topology needed) -------------------------------
+
+def test_histogram_bucketing():
+    """Observations land in the right fixed buckets (bounds are in us;
+    values above the last bound go to the +Inf bucket)."""
+    from byteps_tpu.core import ffi
+
+    name = "test_bucketing_us"
+    for v in (10, 50, 51, 3000, 10**7, 10**7):
+        ffi.metrics_observe("histo", name, v)
+    h = ffi.metrics_snapshot()["histograms"][name]
+    bounds = h["bounds_us"]
+    assert bounds[0] == 50 and bounds[-1] == 5_000_000
+    assert len(h["buckets"]) == len(bounds) + 1
+    by_bound = dict(zip(bounds, h["buckets"]))
+    assert by_bound[50] == 2        # 10 and 50 (le is inclusive)
+    assert by_bound[100] == 1       # 51
+    assert by_bound[5000] == 1      # 3000
+    assert h["buckets"][-1] == 2    # 2x 10^7 overflow the last bound
+    assert h["count"] == 6
+    assert h["sum"] == 10 + 50 + 51 + 3000 + 2 * 10**7
+
+
+def test_counter_and_gauge_roundtrip():
+    from byteps_tpu.core import ffi
+
+    ffi.metrics_observe("counter", "test_ctr_total", 3)
+    ffi.metrics_observe("counter", "test_ctr_total", 4)
+    ffi.metrics_observe("gauge", "test_gauge", 99)
+    ffi.metrics_observe("gauge", "test_gauge", 11)
+    snap = ffi.metrics_snapshot()
+    assert snap["counters"]["test_ctr_total"] == 7
+    assert snap["gauges"]["test_gauge"] == 11
+    with pytest.raises(ValueError):
+        ffi.metrics_observe("bogus", "x", 1)
+
+
+def test_prometheus_exposition_format():
+    """The real snapshot renders to strictly-parseable Prometheus text:
+    histogram buckets are cumulative and monotone, the +Inf bucket equals
+    _count, counters carry the _total suffix."""
+    from byteps_tpu.core import ffi
+
+    for v in (10, 200, 900000):
+        ffi.metrics_observe("histo", "test_expo_us", v)
+    ffi.metrics_observe("counter", "test_expo_total", 5)
+    text = mon_metrics.prometheus_text()
+    parsed = mon_metrics.parse_prometheus(text)  # raises on bad lines
+    assert parsed["test_expo_total"][()] >= 5
+    buckets = parsed["test_expo_us_bucket"]
+    ordered = [buckets[(("le", str(b)),)]
+               for b in ffi.metrics_snapshot()
+               ["histograms"]["test_expo_us"]["bounds_us"]]
+    assert ordered == sorted(ordered), "buckets must be cumulative"
+    assert buckets[(("le", "+Inf"),)] == parsed["test_expo_us_count"][()]
+    # every duration histogram the worker pipeline emits keeps the _us
+    # unit in its name; the van byte counters keep the _total suffix
+    assert "bps_van_sent_bytes_total" in parsed
+    assert "bps_van_recv_bytes_total" in parsed
+
+
+def test_prometheus_text_from_synthetic_snapshot():
+    """Exposition of scheduler-side health state: per-node heartbeat ages
+    and dead-node flags become labelled gauges."""
+    snap = {
+        "counters": {"bps_recv_bytes_total": 123},
+        "gauges": {},
+        "histograms": {},
+        "node": {"inited": True, "role": 0, "id": 0},
+        "van": {"sent_bytes": 1, "recv_bytes": 2},
+        "staleness": {"mean": 0.5, "max": 2, "samples": 4},
+        "queue": {"pending": 0, "inflight_bytes": 0,
+                  "credit_budget_bytes": 0},
+        "heartbeat_age_ms": {"1": 1500, "3": 99},
+        "dead_nodes": [4],
+    }
+    parsed = mon_metrics.parse_prometheus(
+        mon_metrics.prometheus_text(snap))
+    assert parsed["bps_heartbeat_age_ms"][(("node", "1"),)] == 1500
+    assert parsed["bps_heartbeat_age_ms"][(("node", "3"),)] == 99
+    assert parsed["bps_dead_nodes"][()] == 1
+    assert parsed["bps_node_dead"][(("node", "4"),)] == 1
+    assert parsed["bps_async_staleness_mean"][()] == 0.5
+    assert parsed["bps_up"][(("role", "scheduler"), ("node_id", "0"))] == 1
+
+
+def test_parse_prometheus_rejects_garbage():
+    for bad in ("no_value_line", 'name{unquoted=x} 1', "1leading 2"):
+        with pytest.raises(ValueError):
+            mon_metrics.parse_prometheus(bad)
+
+
+def test_python_side_registry_merges_into_exposition():
+    mon_metrics.inc_counter("test_py_steps_total", 2)
+    mon_metrics.set_gauge("test_py_examples_per_sec", 123.5)
+    parsed = mon_metrics.parse_prometheus(mon_metrics.prometheus_text())
+    assert parsed["test_py_steps_total"][()] >= 2
+    assert parsed["test_py_examples_per_sec"][()] == 123.5
+
+
+def test_monitor_callback_publishes_step_metrics():
+    """MonitorCallback feeds step telemetry into the exposition and the
+    loop's state dict — without a live PS topology (wire deltas are then
+    simply zero)."""
+    from byteps_tpu.callbacks import MonitorCallback
+
+    cb = MonitorCallback(batch_size=32)
+    state = {}
+    cb.on_train_begin(state)
+    cb.on_batch_end(0, state)
+    rep = state["monitor"]
+    assert rep["step"] == 1 and rep["step_seconds"] >= 0
+    assert rep["examples_per_sec"] > 0
+    parsed = mon_metrics.parse_prometheus(mon_metrics.prometheus_text())
+    assert parsed["bps_train_steps_total"][()] >= 1
+    assert parsed["bps_examples_per_sec"][()] == pytest.approx(
+        rep["examples_per_sec"])
+
+
+# --- HTTP endpoint (no topology needed) ------------------------------------
+
+def test_monitor_http_endpoint():
+    srv = MonitorServer(0)  # ephemeral port
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            parsed = mon_metrics.parse_prometheus(r.read().decode())
+        assert "bps_up" in parsed
+        # /healthz: this process has no live topology -> degraded + 503
+        # (the launcher-facing health signal).
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5)
+        assert exc.value.code == 503
+        health = json.loads(exc.value.read().decode())
+        assert health["status"] == "degraded" and not health["inited"]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/bogus", timeout=5)
+        assert exc.value.code == 404
+    finally:
+        srv.stop()
+
+
+# --- monitor.top analysis (synthetic scrapes) ------------------------------
+
+def _worker_metrics(mean_us: float, count: int = 10) -> dict:
+    return {
+        "bps_push_us_sum": {(): mean_us * count},
+        "bps_push_us_count": {(): count},
+        "bps_push_bytes_total": {(): 1000},
+        "bps_pull_bytes_total": {(): 1000},
+        "bps_queue_pending": {(): 0},
+        "bps_queue_inflight_bytes": {(): 0},
+        "bps_queue_credit_budget_bytes": {(): 4096},
+    }
+
+
+def test_top_flags_push_latency_skew():
+    scrapes = {
+        "worker0": _worker_metrics(800.0),
+        "worker1": _worker_metrics(900.0),
+        "worker2": _worker_metrics(250_000.0),
+        "scheduler": {},
+    }
+    report = analyze(scrapes, straggler_factor=2.0)
+    assert report["stragglers"] == ["worker2"]
+    assert report["baseline_push_us"] == 900.0  # low-median of means
+
+
+def test_top_absolute_floor_suppresses_microsecond_noise():
+    """Sub-millisecond skew (40 us vs 200 us on loopback) is noise, not a
+    straggler — the 1 ms absolute floor keeps it quiet."""
+    scrapes = {"worker0": _worker_metrics(40.0),
+               "worker1": _worker_metrics(200.0)}
+    assert analyze(scrapes, straggler_factor=2.0)["stragglers"] == []
+
+
+def test_top_health_from_scheduler_scrape():
+    sched = {
+        "bps_heartbeat_age_ms": {(("node", "1"),): 500.0,
+                                 (("node", "3"),): 45_000.0},
+        "bps_node_dead": {(("node", "4"),): 1.0},
+    }
+    report = analyze({"scheduler": sched, "worker0": None},
+                     heartbeat_timeout_s=30.0)
+    assert report["stale_nodes"] == [3]
+    assert report["dead_nodes"] == [4]
+    assert report["unreachable"] == ["worker0"]
+
+
+def test_fleet_endpoint_layout_matches_node_ids():
+    eps = fleet_endpoints("127.0.0.1", 9100, num_workers=2, num_servers=2)
+    assert eps == {
+        "scheduler": "127.0.0.1:9100",
+        "server0": "127.0.0.1:9101",
+        "server1": "127.0.0.1:9102",
+        "worker0": "127.0.0.1:9103",
+        "worker1": "127.0.0.1:9104",
+    }
+
+
+# --- real-topology integration (slow tier) ---------------------------------
+
+def _free_port_block(n: int) -> int:
+    """A base port with n consecutive free ports (monitor ports are
+    base + node_id)."""
+    import random
+    import socket
+
+    rng = random.Random()
+    for _ in range(50):
+        base = rng.randrange(20000, 55000)
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port block found")
+
+
+@pytest.mark.ps
+def test_metrics_wire_byte_parity_2workers_2servers():
+    """The acceptance run (ISSUE 1): 2 workers x 2 servers on CPU; a
+    worker's /metrics is Prometheus-parseable and the fleet-wide
+    bps_push_bytes_total equals the server-side bps_recv_bytes_total sum
+    exactly (asserted inside mode=monitor via real HTTP scrapes)."""
+    base = _free_port_block(5)  # scheduler + 2 servers + 2 workers
+    run_topology(2, 2, WORKER, mode="monitor",
+                 extra={"BYTEPS_MONITOR_ON": "1",
+                        "BYTEPS_MONITOR_PORT": str(base)})
+
+
+@pytest.mark.ps
+def test_top_flags_paced_straggler(tmp_path):
+    """An artificially delayed worker (kernel-paced sends: 2 MB/s against
+    1 MB pushes inflate its real push latency ~3 orders of magnitude)
+    must be flagged by monitor.top while the fleet is live."""
+    from byteps_tpu.monitor.top import scrape
+
+    base = _free_port_block(4)  # scheduler + 1 server + 2 workers
+    go_file = str(tmp_path / "go")
+    port = free_port()
+    env = topology_env(2, 1, port,
+                       {"BYTEPS_MONITOR_ON": "1",
+                        "BYTEPS_MONITOR_PORT": str(base),
+                        "BPS_TEST_GO_FILE": go_file})
+    sched = spawn_role("scheduler", env)
+    server = spawn_role("server", env)
+    workers = [
+        spawn_worker(WORKER, env, 0, "monitor_hold"),
+        spawn_worker(WORKER, env, 1, "monitor_hold",
+                     extra={"BYTEPS_PACING_RATE": "2000000"}),
+    ]
+    try:
+        for p in workers:
+            for line in p.stdout:
+                if line.startswith("ready"):
+                    break
+        eps = fleet_endpoints("127.0.0.1", base, 2, 1)
+        scrapes = {name: scrape(ep) for name, ep in eps.items()}
+        report = analyze(scrapes, straggler_factor=2.0,
+                         heartbeat_timeout_s=30.0)
+        assert report["unreachable"] == [], report["unreachable"]
+        assert report["stragglers"] == ["worker1"], report
+        assert report["workers"]["worker1"]["push_mean_us"] > 10 * \
+            report["workers"]["worker0"]["push_mean_us"], report
+        # the scheduler endpoint reports fresh heartbeats, nobody dead
+        assert report["dead_nodes"] == [] and report["stale_nodes"] == []
+    finally:
+        with open(go_file, "w") as f:
+            f.write("go")
+        for p in (sched, server, *workers):
+            try:
+                p.communicate(timeout=60)
+            except Exception:
+                p.kill()
+                p.communicate()
+    assert all(p.returncode == 0 for p in (sched, server, *workers))

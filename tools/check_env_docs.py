@@ -1,0 +1,62 @@
+#!/usr/bin/env python3
+"""Lint: every environment variable the Config system reads must be
+documented in docs/env.md.
+
+The config surface IS env vars (docs/env.md is the operator contract,
+reference parity); an env var that ships undocumented is a knob nobody
+can find. Wired into tier-1 via tests/test_env_docs.py; also runnable
+standalone:
+
+    python tools/check_env_docs.py      # exit 1 + listing on violations
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIG_PY = os.path.join(REPO, "byteps_tpu", "config.py")
+ENV_MD = os.path.join(REPO, "docs", "env.md")
+
+# Every way config.py reads the environment.
+_READ_PATTERNS = (
+    r'_env_int\(\s*"([A-Z][A-Z0-9_]*)"',
+    r'_env_bool\(\s*"([A-Z][A-Z0-9_]*)"',
+    r'_env_str\(\s*"([A-Z][A-Z0-9_]*)"',
+    r'os\.environ\.get\(\s*"([A-Z][A-Z0-9_]*)"',
+    r'os\.environ\[\s*"([A-Z][A-Z0-9_]*)"\s*\]',
+)
+
+
+def config_env_vars() -> set:
+    with open(CONFIG_PY) as f:
+        src = f.read()
+    found = set()
+    for pat in _READ_PATTERNS:
+        found.update(re.findall(pat, src))
+    return found
+
+
+def undocumented() -> list:
+    with open(ENV_MD) as f:
+        docs = f.read()
+    return sorted(v for v in config_env_vars() if v not in docs)
+
+
+def main() -> int:
+    missing = undocumented()
+    n = len(config_env_vars())
+    if missing:
+        print(f"check_env_docs: {len(missing)} Config env var(s) missing "
+              f"from docs/env.md:", file=sys.stderr)
+        for v in missing:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print(f"check_env_docs: OK ({n} env vars all documented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
