@@ -145,7 +145,14 @@ def mode_chip(args):
         ("bf16_dense", ["--wire", "bf16"]),
     ]
     if args.codecs:
+        # Same validation as mode_converge: unknown names must error, not
+        # silently filter to an empty run list and write a hollow artifact.
         want = set(args.codecs.split(","))
+        unknown = want - {n for n, _ in configs}
+        if unknown:
+            raise SystemExit(
+                f"unknown codecs {sorted(unknown)} for --mode chip; "
+                f"choose from {sorted(n for n, _ in configs)}")
         configs = [(n, e) for n, e in configs if n in want]
     for name, extra in configs:
         row = run_launcher(

@@ -58,6 +58,15 @@ class Config:
     scheduling_credit: int = 0            # BYTEPS_SCHEDULING_CREDIT
     #   in-flight BYTE budget for the DCN push stage (reference semantics);
     #   0 = auto: 4 x partition_bytes
+    fusion_bytes: int = 65536             # BYTEPS_FUSION_BYTES
+    #   small-tensor fusion: partitions under this many raw bytes are
+    #   coalesced into one multi-key wire frame per (server, flush);
+    #   0 disables fusion (pre-fusion wire protocol, byte for byte)
+    fusion_keys: int = 128                # BYTEPS_FUSION_KEYS
+    #   max sub-operations per fused frame (flush-by-keys bound)
+    fusion_linger_us: int = 200           # BYTEPS_FUSION_LINGER_US
+    #   how long the collector waits for the next fusible task before
+    #   flushing a partial batch (0 = flush immediately)
     local_rank: int = 0                   # BYTEPS_LOCAL_RANK
     local_size: int = 1                   # BYTEPS_LOCAL_SIZE
     log_level: str = "WARNING"            # BYTEPS_LOG_LEVEL
@@ -142,6 +151,20 @@ class Config:
                 f"{self.partition_bytes} bytes (it is now a BYTE budget; "
                 "set 0 for auto = 4 x BYTEPS_PARTITION_BYTES)",
                 stacklevel=2)
+        if self.fusion_bytes < 0:
+            raise ValueError(
+                "BYTEPS_FUSION_BYTES must be >= 0 (0 disables small-"
+                "tensor fusion; partitions under the threshold are "
+                "coalesced into multi-key frames)")
+        if self.fusion_keys < 2:
+            raise ValueError(
+                "BYTEPS_FUSION_KEYS must be >= 2 (a fused frame needs at "
+                "least two sub-operations; use BYTEPS_FUSION_BYTES=0 to "
+                "disable fusion)")
+        if self.fusion_linger_us < 0:
+            raise ValueError(
+                "BYTEPS_FUSION_LINGER_US must be >= 0 (microseconds the "
+                "fusion collector waits before flushing a partial batch)")
         if self.num_worker < 1:
             raise ValueError("DMLC_NUM_WORKER must be >= 1")
         if self.ps_mode not in ("auto", "collective", "ps"):
@@ -169,6 +192,9 @@ def load_config() -> Config:
         worker_id=_env_int("DMLC_WORKER_ID", 0),
         partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", 4096000),
         scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
+        fusion_bytes=_env_int("BYTEPS_FUSION_BYTES", 65536),
+        fusion_keys=_env_int("BYTEPS_FUSION_KEYS", 128),
+        fusion_linger_us=_env_int("BYTEPS_FUSION_LINGER_US", 200),
         local_rank=_env_int("BYTEPS_LOCAL_RANK", 0),
         local_size=_env_int("BYTEPS_LOCAL_SIZE", 1),
         log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING").upper(),

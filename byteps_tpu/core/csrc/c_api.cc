@@ -158,6 +158,11 @@ int bps_init(int role) {
     gl->worker->Start(gl->po.get(), gl->kv.get(),
                       EnvInt64("BYTEPS_PARTITION_BYTES", 4096000),
                       EnvInt64("BYTEPS_SCHEDULING_CREDIT", 0),
+                      // Small-tensor fusion: partitions under this many
+                      // raw bytes coalesce into CMD_MULTI_PUSH frames
+                      // (0 = off -> pre-fusion wire protocol verbatim).
+                      EnvInt64("BYTEPS_FUSION_BYTES", 65536),
+                      EnvInt("BYTEPS_FUSION_KEYS", 128),
                       DefaultCompConfig(), EnvBool("BYTEPS_TRACE_ON"));
   }
   gl->inited = true;

@@ -71,6 +71,16 @@ enum Command : int32_t {
                          // data path; payload = shm segment name, arg0 =
                          // per-direction ring bytes. Never reaches upper
                          // layers.
+  // Small-tensor fusion (BYTEPS_FUSION_BYTES): many sub-partition-size
+  // operations for ONE server coalesced into a single frame. Payload =
+  // arg0 x SubHeader table + gathered sub-payloads (offset/len per
+  // entry). One req_id covers the whole batch; replies are batched the
+  // same way, so a conv net's hundreds of tiny tensors pay one framed
+  // round trip per flush instead of one per key.
+  CMD_MULTI_PUSH = 17,       // worker -> server: batched CMD_PUSH ops
+  CMD_MULTI_ACK = 18,        // server -> worker: batched push acks
+  CMD_MULTI_PULL = 19,       // worker -> server: batched CMD_PULL ops
+  CMD_MULTI_PULL_RESP = 20,  // server -> worker: batched pull responses
 };
 
 // --- message flags ----------------------------------------------------------
@@ -98,6 +108,27 @@ struct MsgHeader {
   int64_t arg0 = 0;        // cmd-specific (e.g. decompressed len for PUSH,
                            // listen port for REGISTER, count for BARRIER)
   int64_t arg1 = 0;        // cmd-specific (e.g. role for REGISTER)
+};
+#pragma pack(pop)
+
+// Per-operation entry in a CMD_MULTI_* frame. The frame header's arg0
+// holds the entry count; the payload is the packed table followed by the
+// gathered sub-payload bytes, each entry's slice at [offset, offset+len).
+// `cmd` names the sub-operation (CMD_PUSH / CMD_PULL on requests,
+// CMD_PUSH_ACK / CMD_PULL_RESP on replies) so one table layout serves
+// all four multi commands; arg0/arg1 mirror the cmd-specific fields of
+// the equivalent single-frame MsgHeader (raw len, async apply count).
+#pragma pack(push, 1)
+struct SubHeader {
+  int64_t key = 0;
+  int32_t cmd = 0;
+  int32_t version = 0;
+  int32_t dtype = 0;
+  int32_t flags = 0;
+  int64_t arg0 = 0;
+  int64_t arg1 = 0;
+  int64_t offset = 0;  // byte offset into the gathered payload region
+  int64_t len = 0;     // sub-payload bytes (0 for pulls / bare acks)
 };
 #pragma pack(pop)
 

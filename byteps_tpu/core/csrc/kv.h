@@ -7,6 +7,8 @@
 // server.h; this class is the worker-side half.
 #pragma once
 
+#include <sys/uio.h>
+
 #include <condition_variable>
 #include <deque>
 #include <functional>
@@ -66,6 +68,20 @@ class KVWorker {
   // callback then fires with CMD_ERROR before Request returns).
   int Request(int node_id, MsgHeader head, const void* payload,
               int64_t payload_len, Callback cb) {
+    struct iovec one;
+    one.iov_base = const_cast<void*>(payload);
+    one.iov_len = static_cast<size_t>(payload_len > 0 ? payload_len : 0);
+    return RequestV(node_id, head, &one, payload_len > 0 ? 1 : 0,
+                    std::move(cb));
+  }
+
+  // Gather variant (fusion layer): the request payload is the
+  // concatenation of `nsegs` segments, sent via the van's writev path
+  // with no staging copy. ONE req_id covers the whole frame — the server
+  // answers a CMD_MULTI_* batch with a single batched reply, so `cb`
+  // fires once for the entire sub-operation set.
+  int RequestV(int node_id, MsgHeader head, const struct iovec* segs,
+               int nsegs, Callback cb) {
     int rid;
     bool dead;
     {
@@ -95,9 +111,11 @@ class KVWorker {
     head.sender = po_->my_id();
     head.req_id = rid;
     // Striped by key (BYTEPS_VAN_STREAMS): one key's chain stays on one
-    // connection, so per-key ordering survives striping.
-    if (!po_->van().Send(po_->FdOf(node_id, head.key), head, payload,
-                         payload_len)) {
+    // connection, so per-key ordering survives striping. Multi frames
+    // stripe by head.key = their first sub-key; a fused batch rides one
+    // connection, keeping its sub-keys' request/reply order intact.
+    if (!po_->van().SendV(po_->FdOf(node_id, head.key), head, segs,
+                          nsegs)) {
       // Dead connection: the response can never come. Mark the node and
       // fail THIS request immediately (VERDICT r2 weak #7 — a push into
       // a dead connection used to block its handle until the heartbeat

@@ -13,6 +13,7 @@
 // Push/Pop/ReleaseCredit.
 #pragma once
 
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -50,6 +51,11 @@ struct Task {
   int64_t seq = 0;        // FIFO tie-break within a priority level
   int64_t key = 0;
   int64_t bytes = 0;      // raw partition bytes charged against the budget
+  // Small-tensor fusion (BYTEPS_FUSION_BYTES): tasks under the threshold
+  // are fusible; the worker's PushLoop coalesces consecutive fusible
+  // pops bound for the same server into one CMD_MULTI_PUSH frame.
+  int server_id = -1;
+  bool fusible = false;
   std::function<void()> run;
 };
 
@@ -103,6 +109,47 @@ class ScheduledQueue {
               (long long)inflight_bytes_, heap_.size());
     }
     return true;
+  }
+
+  // Bounded-wait companion to Pop for the fusion collector: pops the
+  // top task when it is fusible (any server — the byte-balanced
+  // partition->server assignment interleaves servers at the queue head,
+  // so the collector accumulates one batch per server concurrently) and
+  // fits the credit budget. When the queue is EMPTY it waits up to
+  // `wait_us` microseconds for a matching task to arrive — the flush
+  // linger that lets a batch form while the (slower) enqueuing thread
+  // is still pumping tasks in; pass 0 for a pure non-blocking attempt.
+  // A NON-fusible task at the top returns false immediately: the
+  // collector must flush rather than delay a full partition, and
+  // popping only the heap top keeps the priority order intact — fusion
+  // changes how partitions share frames, never which goes first.
+  bool TryPopFusible(int64_t wait_us, Task* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::microseconds(wait_us);
+    for (;;) {
+      if (stopped_) return false;
+      if (!heap_.empty()) {
+        const Task& top = heap_.top();
+        if (!top.fusible) return false;
+        if (inflight_bytes_ > 0 && inflight_bytes_ + top.bytes > budget_)
+          return false;
+        *out = heap_.top();
+        heap_.pop();
+        inflight_bytes_ += out->bytes;
+        if (QueueDebug()) {
+          fprintf(stderr, "[QDEBUG] pop(fuse) key=%lld bytes=%lld "
+                  "inflight=%lld pending=%zu\n", (long long)out->key,
+                  (long long)out->bytes, (long long)inflight_bytes_,
+                  heap_.size());
+        }
+        return true;
+      }
+      if (wait_us <= 0 ||
+          cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+        if (heap_.empty()) return false;
+      }
+    }
   }
 
   // Called when a partition completes its pull (reference: reportFinish).

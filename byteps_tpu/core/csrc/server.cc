@@ -1,5 +1,7 @@
 #include "server.h"
 
+#include <sys/uio.h>
+
 #include <cstring>
 
 #include "cpu_reducer.h"
@@ -21,7 +23,9 @@ void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
   Metrics::Get().Counter("bps_server_pull_total");
   Metrics::Get().Counter("bps_server_reply_bytes_total");
   Metrics::Get().Counter("bps_server_sum_bytes_total");
+  Metrics::Get().Counter("bps_fused_msgs_total");
   Metrics::Get().Histogram("bps_server_sum_us");
+  Metrics::Get().Histogram("bps_fusion_batch_keys");
   queues_.clear();
   for (int i = 0; i < engine_threads; ++i) {
     queues_.push_back(std::make_unique<EngineQueue>());
@@ -34,6 +38,10 @@ void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
 }
 
 void BytePSServer::Handle(Message&& msg, int fd) {
+  if (msg.head.cmd == CMD_MULTI_PUSH || msg.head.cmd == CMD_MULTI_PULL) {
+    HandleMulti(std::move(msg), fd);
+    return;
+  }
   // Wire accounting here, NOT in Process(): parked pushes replay through
   // Process (ReplayParked), and counting a replay again would break the
   // push-bytes parity contract with the workers (docs/monitoring.md).
@@ -49,9 +57,132 @@ void BytePSServer::Handle(Message&& msg, int fd) {
   auto& eq = *queues_[tid];
   {
     std::lock_guard<std::mutex> lk(eq.mu);
-    eq.q.push_back(EngineTask{std::move(msg), fd});
+    eq.q.push_back(EngineTask{std::move(msg), fd, nullptr, -1});
   }
   eq.cv.notify_one();
+}
+
+void BytePSServer::HandleMulti(Message&& msg, int fd) {
+  const MsgHeader& h = msg.head;
+  const bool is_push = h.cmd == CMD_MULTI_PUSH;
+  int count = static_cast<int>(h.arg0);
+  int64_t table_bytes =
+      static_cast<int64_t>(count) * static_cast<int64_t>(sizeof(SubHeader));
+  BPS_CHECK(count > 0 &&
+            table_bytes <= static_cast<int64_t>(msg.payload.size()))
+      << "malformed multi frame: count=" << count << " payload="
+      << msg.payload.size();
+  const SubHeader* table =
+      reinterpret_cast<const SubHeader*>(msg.payload.data());
+  const char* gathered = msg.payload.data() + table_bytes;
+  int64_t gathered_len =
+      static_cast<int64_t>(msg.payload.size()) - table_bytes;
+  // Wire/parity accounting mirrors the single-frame path exactly: a
+  // fused frame's CMD_PUSH payload bytes are its SUB-payload bytes (the
+  // table is framing, like headers), so worker-side push totals and
+  // server-side recv totals still sum to the same number fleet-wide.
+  if (is_push) {
+    int64_t pbytes = 0;
+    for (int i = 0; i < count; ++i) pbytes += table[i].len;
+    BPS_METRIC_COUNTER_ADD("bps_recv_bytes_total", pbytes);
+    BPS_METRIC_COUNTER_ADD("bps_server_push_total", count);
+  } else {
+    BPS_METRIC_COUNTER_ADD("bps_server_pull_total", count);
+  }
+  BPS_METRIC_COUNTER_ADD("bps_fused_msgs_total", 1);
+  BPS_METRIC_HISTO_OBSERVE("bps_fusion_batch_keys", count);
+  auto batch = std::make_shared<MultiReply>();
+  batch->fd = fd;
+  batch->req_id = h.req_id;
+  batch->reply_cmd = is_push ? CMD_MULTI_ACK : CMD_MULTI_PULL_RESP;
+  batch->first_key = h.key;
+  batch->subs.resize(count);
+  batch->data.resize(count);
+  batch->remaining.store(count);
+  for (int i = 0; i < count; ++i) {
+    const SubHeader& s = table[i];
+    BPS_CHECK(s.offset >= 0 && s.len >= 0 &&
+              s.offset + s.len <= gathered_len)
+        << "multi sub-payload out of range: key " << s.key;
+    BPS_CHECK_EQ(s.cmd, is_push ? CMD_PUSH : CMD_PULL)
+        << "unexpected sub-cmd in multi frame";
+    EngineTask t;
+    t.msg.head.cmd = s.cmd;
+    t.msg.head.sender = h.sender;
+    t.msg.head.key = s.key;
+    t.msg.head.req_id = h.req_id;
+    t.msg.head.dtype = s.dtype;
+    t.msg.head.payload_len = s.len;
+    t.msg.head.flags = s.flags;
+    t.msg.head.version = s.version;
+    t.msg.head.arg0 = s.arg0;
+    if (s.len > 0) {
+      // Own copy: a sub-push may be parked past the frame buffer's life.
+      t.msg.payload.assign(gathered + s.offset, gathered + s.offset + s.len);
+    }
+    t.fd = fd;
+    t.batch = batch;
+    t.sub_idx = i;
+    // Same key hash routing as single frames: all of a key's operations
+    // — fused or not — stay totally ordered on one engine thread, and
+    // the KeyStore keeps its single-writer invariant.
+    size_t tid = static_cast<size_t>(s.key) % queues_.size();
+    auto& eq = *queues_[tid];
+    {
+      std::lock_guard<std::mutex> lk(eq.mu);
+      eq.q.push_back(std::move(t));
+    }
+    eq.cv.notify_one();
+  }
+}
+
+void BytePSServer::SendReply(const EngineTask& t, MsgHeader& head,
+                             const void* data, int64_t len) {
+  if (!t.batch) {
+    po_->van().Send(t.fd, head, data, len);
+    return;
+  }
+  MultiReply& b = *t.batch;
+  SubHeader& s = b.subs[t.sub_idx];
+  s.key = head.key;
+  s.cmd = head.cmd;
+  s.version = head.version;
+  s.dtype = head.dtype;
+  s.flags = head.flags;
+  s.arg0 = head.arg0;
+  s.arg1 = head.arg1;
+  s.len = len;
+  if (len > 0) {
+    // Copy: pull responses point into the slot buffer, which a parked
+    // push replayed by THIS round's recycle may overwrite before the
+    // batch's last sub-op settles and flushes.
+    b.data[t.sub_idx].assign(static_cast<const char*>(data),
+                             static_cast<const char*>(data) + len);
+  }
+  if (b.remaining.fetch_sub(1) == 1) FlushMulti(t.batch);
+}
+
+void BytePSServer::FlushMulti(const std::shared_ptr<MultiReply>& batch) {
+  MultiReply& b = *batch;
+  int count = static_cast<int>(b.subs.size());
+  std::vector<iovec> segs;
+  segs.reserve(static_cast<size_t>(count) + 1);
+  segs.push_back({b.subs.data(), static_cast<size_t>(count) * sizeof(SubHeader)});
+  int64_t off = 0;
+  for (int i = 0; i < count; ++i) {
+    b.subs[i].offset = off;
+    off += b.subs[i].len;
+    if (b.subs[i].len > 0) {
+      segs.push_back({b.data[i].data(), b.data[i].size()});
+    }
+  }
+  MsgHeader head{};
+  head.cmd = b.reply_cmd;
+  head.sender = po_->my_id();
+  head.key = b.first_key;
+  head.req_id = b.req_id;
+  head.arg0 = count;
+  po_->van().SendV(b.fd, head, segs.data(), static_cast<int>(segs.size()));
 }
 
 void BytePSServer::EngineLoop(int tid) {
@@ -65,7 +196,7 @@ void BytePSServer::EngineLoop(int tid) {
       task = std::move(eq.q.front());
       eq.q.pop_front();
     }
-    Process(std::move(task.msg), task.fd);
+    Process(std::move(task));
   }
 }
 
@@ -75,8 +206,10 @@ BytePSServer::KeyStore* BytePSServer::GetStore(int64_t key) {
   return it == store_.end() ? nullptr : it->second.get();
 }
 
-void BytePSServer::Process(Message&& msg, int fd) {
+void BytePSServer::Process(EngineTask&& task) {
+  Message& msg = task.msg;
   const MsgHeader& h = msg.head;
+  const int fd = task.fd;
   switch (h.cmd) {
     case CMD_INIT_KEY: {
       {
@@ -129,7 +262,7 @@ void BytePSServer::Process(Message&& msg, int fd) {
         bool busy = ks->ready[slot] ||
                     (ks->push_count[slot] > 0 && ks->round[slot] != h.version);
         if (busy) {
-          ks->parked_pushes[slot].emplace_back(std::move(msg), fd);
+          ks->parked_pushes[slot].push_back(std::move(task));
           break;
         }
       }
@@ -189,14 +322,14 @@ void BytePSServer::Process(Message&& msg, int fd) {
           // this round's; a later round's pulls stay parked. Move the
           // list out first: ReplyPull may recycle the slot, and its
           // replay can append fresh entries.
-          std::vector<std::pair<int, MsgHeader>> waiting;
+          std::vector<EngineTask> waiting;
           waiting.swap(ks->pending_pulls[slot]);
           bool recycled = false;
           for (auto& p : waiting) {
-            if (p.second.version == h.version) {
-              recycled |= ReplyPull(ks, slot, p.first, p.second);
+            if (p.msg.head.version == h.version) {
+              recycled |= ReplyPull(ks, slot, p);
             } else {
-              ks->pending_pulls[slot].push_back(p);
+              ks->pending_pulls[slot].push_back(std::move(p));
             }
           }
           if (recycled) ReplayParked(ks, slot);
@@ -208,7 +341,7 @@ void BytePSServer::Process(Message&& msg, int fd) {
       ack.key = h.key;
       ack.req_id = h.req_id;
       if (is_async) ack.arg1 = ks->async_pushes;
-      po_->van().Send(fd, ack);
+      SendReply(task, ack);
       break;
     }
 
@@ -226,13 +359,13 @@ void BytePSServer::Process(Message&& msg, int fd) {
         BPS_CHECK(ks->param_init) << "async pull before any push " << h.key;
         BPS_METRIC_COUNTER_ADD("bps_server_reply_bytes_total",
                                static_cast<int64_t>(ks->param.size()));
-        po_->van().Send(fd, resp, ks->param.data(), ks->param.size());
+        SendReply(task, resp, ks->param.data(), ks->param.size());
       } else {
         int slot = h.version & 1;
         if (ks->ready[slot] && ks->round[slot] == h.version) {
-          if (ReplyPull(ks, slot, fd, h)) ReplayParked(ks, slot);
+          if (ReplyPull(ks, slot, task)) ReplayParked(ks, slot);
         } else {
-          ks->pending_pulls[slot].emplace_back(fd, h);
+          ks->pending_pulls[slot].push_back(std::move(task));
         }
       }
       break;
@@ -299,8 +432,8 @@ void BytePSServer::Process(Message&& msg, int fd) {
   }
 }
 
-bool BytePSServer::ReplyPull(KeyStore* ks, int slot, int fd,
-                             const MsgHeader& req) {
+bool BytePSServer::ReplyPull(KeyStore* ks, int slot, const EngineTask& t) {
+  const MsgHeader& req = t.msg.head;
   MsgHeader resp{};
   resp.cmd = CMD_PULL_RESP;
   resp.sender = po_->my_id();
@@ -314,12 +447,12 @@ bool BytePSServer::ReplyPull(KeyStore* ks, int slot, int fd,
     BPS_METRIC_COUNTER_ADD(
         "bps_server_reply_bytes_total",
         static_cast<int64_t>(ks->comp_reply[slot].size()));
-    po_->van().Send(fd, resp, ks->comp_reply[slot].data(),
-                    ks->comp_reply[slot].size());
+    SendReply(t, resp, ks->comp_reply[slot].data(),
+              ks->comp_reply[slot].size());
   } else {
     BPS_METRIC_COUNTER_ADD("bps_server_reply_bytes_total",
                            static_cast<int64_t>(ks->slot[slot].size()));
-    po_->van().Send(fd, resp, ks->slot[slot].data(), ks->slot[slot].size());
+    SendReply(t, resp, ks->slot[slot].data(), ks->slot[slot].size());
   }
   if (++ks->pull_count[slot] == po_->num_workers()) {
     // Round fully served; recycle the slot for round r+2.
@@ -340,8 +473,7 @@ void BytePSServer::ReplayParked(KeyStore* ks, int slot) {
   auto parked = std::move(ks->parked_pushes[slot]);
   ks->parked_pushes[slot].clear();
   for (auto& t : parked) {
-    int pfd = t.second;
-    Process(std::move(t.first), pfd);
+    Process(std::move(t));
   }
 }
 

@@ -12,6 +12,15 @@
 // serialises all work for one key on one thread — per-key ordering without
 // per-key locks. Sync-mode rounds are double-buffered by version parity
 // (head.version), tolerating the legal one-round skew between workers.
+//
+// Small-tensor fusion (CMD_MULTI_PUSH / CMD_MULTI_PULL): a fused frame is
+// unpacked on the van thread into one EngineTask per sub-operation, each
+// routed to its key's engine thread exactly like a single frame — per-key
+// total ordering and the KeyStore single-writer invariant hold unchanged.
+// The sub-tasks share a MultiReply accumulator; each sub-op's reply (ack
+// or pull response, whenever it fires — parked pushes and pending pulls
+// included) lands in its slot, and the LAST one to settle sends a single
+// batched CMD_MULTI_ACK / CMD_MULTI_PULL_RESP frame back.
 #pragma once
 
 #include <atomic>
@@ -37,6 +46,32 @@ class BytePSServer {
   ~BytePSServer() { Stop(); }
 
  private:
+  // Accumulator for one fused frame's batched reply. subs/data are
+  // indexed by the request table position, so the reply table preserves
+  // the worker's sub-operation order; each slot is written by exactly one
+  // engine thread (the key's owner) and `remaining`'s final decrement
+  // publishes them to the flusher.
+  struct MultiReply {
+    int fd = -1;
+    int32_t req_id = -1;
+    int32_t reply_cmd = 0;  // CMD_MULTI_ACK or CMD_MULTI_PULL_RESP
+    int64_t first_key = 0;
+    std::atomic<int> remaining{0};
+    std::vector<SubHeader> subs;
+    std::vector<std::vector<char>> data;  // owned reply payload copies
+  };
+
+  struct KeyStore;
+
+  // One unit of engine work: a single frame, or one sub-operation of a
+  // fused frame (batch != nullptr; sub_idx = its reply slot).
+  struct EngineTask {
+    Message msg;
+    int fd = -1;
+    std::shared_ptr<MultiReply> batch;
+    int sub_idx = -1;
+  };
+
   struct KeyStore {
     int64_t len = 0;  // decompressed payload bytes
     int32_t dtype = BPS_FLOAT32;
@@ -61,8 +96,8 @@ class BytePSServer {
     int pull_count[2] = {0, 0};
     bool ready[2] = {false, false};
     int round[2] = {-1, -1};
-    std::vector<std::pair<int, MsgHeader>> pending_pulls[2];
-    std::vector<std::pair<Message, int>> parked_pushes[2];
+    std::vector<EngineTask> pending_pulls[2];
+    std::vector<EngineTask> parked_pushes[2];
     // async mode: server-resident value
     std::vector<char> param;
     bool param_init = false;
@@ -83,17 +118,21 @@ class BytePSServer {
     std::vector<std::pair<int, MsgHeader>> pending_bcast_pulls;
   };
 
-  struct EngineTask {
-    Message msg;
-    int fd;
-  };
-
   void EngineLoop(int tid);
-  void Process(Message&& msg, int fd);
+  void Process(EngineTask&& task);
+  // Fused-frame entry (van thread): unpack, account, fan sub-operations
+  // out to their keys' engine threads under a shared MultiReply.
+  void HandleMulti(Message&& msg, int fd);
+  // Reply path shared by single and fused tasks: direct van send when the
+  // task is a lone frame, reply-slot capture (and batch flush when it was
+  // the last outstanding sub-op) when it belongs to a fused frame.
+  void SendReply(const EngineTask& t, MsgHeader& head,
+                 const void* data = nullptr, int64_t len = 0);
+  void FlushMulti(const std::shared_ptr<MultiReply>& batch);
   KeyStore* GetStore(int64_t key);
   // Returns true when this pull completed the round and recycled the
   // slot (caller must then ReplayParked).
-  bool ReplyPull(KeyStore* ks, int slot, int fd, const MsgHeader& req);
+  bool ReplyPull(KeyStore* ks, int slot, const EngineTask& t);
   void ReplayParked(KeyStore* ks, int slot);
   void ReplyBcastPull(KeyStore* ks, int fd, const MsgHeader& req);
   void ServeBcastRound(KeyStore* ks, int round, int fd,

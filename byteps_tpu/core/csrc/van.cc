@@ -19,10 +19,16 @@
 #endif
 
 #include <cerrno>
+#include <climits>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <new>
+#include <vector>
+
+#ifndef IOV_MAX
+#define IOV_MAX 1024
+#endif
 
 #include "logging.h"
 #include "metrics.h"
@@ -151,7 +157,22 @@ static bool ReapZerocopy(int fd, uint32_t seq, uint32_t* reaped,
     mh.msg_controllen = sizeof(ctrl);
     ssize_t r = ::recvmsg(fd, &mh, MSG_ERRQUEUE);
     if (r < 0) {
-      if (errno == EINTR || errno == EAGAIN) continue;
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN) {
+        // POLLERR with an EMPTY errqueue: the error is on the socket
+        // itself (peer reset), not a zerocopy completion. Without these
+        // checks the loop spins at 100% CPU — poll returns instantly on
+        // the standing POLLERR, recvmsg keeps yielding EAGAIN — while
+        // holding the per-fd send lock, so even Van::Stop can't break in.
+        if (stop.load()) return false;
+        int soerr = 0;
+        socklen_t slen = sizeof(soerr);
+        if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen) == 0 &&
+            soerr != 0) {
+          return false;  // dead connection; completion will never come
+        }
+        continue;
+      }
       return false;
     }
     for (cmsghdr* c = CMSG_FIRSTHDR(&mh); c; c = CMSG_NXTHDR(&mh, c)) {
@@ -349,6 +370,18 @@ int Van::Connect(const std::string& host, int port) {
 
 bool Van::Send(int fd, const MsgHeader& head, const void* payload,
                int64_t payload_len) {
+  iovec one;
+  one.iov_base = const_cast<void*>(payload);
+  one.iov_len = static_cast<size_t>(payload_len > 0 ? payload_len : 0);
+  return SendV(fd, head, &one, payload_len > 0 ? 1 : 0);
+}
+
+bool Van::SendV(int fd, const MsgHeader& head, const struct iovec* segs,
+                int nsegs) {
+  int64_t payload_len = 0;
+  for (int i = 0; i < nsegs; ++i) {
+    payload_len += static_cast<int64_t>(segs[i].iov_len);
+  }
   MsgHeader h = head;
   h.payload_len = payload_len;
   uint64_t total = sizeof(MsgHeader) + static_cast<uint64_t>(payload_len);
@@ -376,15 +409,20 @@ bool Van::Send(int fd, const MsgHeader& head, const void* payload,
     bytes_sent_.fetch_add(
         static_cast<int64_t>(sizeof(total) + total),
         std::memory_order_relaxed);
-    return ShmStreamWrite(shm->out, shm->out_ring, shm->cap, &total,
-                          sizeof(total)) &&
-           ShmStreamWrite(shm->out, shm->out_ring, shm->cap, &h,
-                          sizeof(h)) &&
-           (payload_len <= 0 ||
-            ShmStreamWrite(shm->out, shm->out_ring, shm->cap, payload,
-                           static_cast<size_t>(payload_len)));
+    if (!ShmStreamWrite(shm->out, shm->out_ring, shm->cap, &total,
+                        sizeof(total)) ||
+        !ShmStreamWrite(shm->out, shm->out_ring, shm->cap, &h, sizeof(h)))
+      return false;
+    for (int i = 0; i < nsegs; ++i) {
+      if (segs[i].iov_len == 0) continue;
+      if (!ShmStreamWrite(shm->out, shm->out_ring, shm->cap,
+                          segs[i].iov_base, segs[i].iov_len))
+        return false;
+    }
+    return true;
   }
-  if (zcs && payload_len >= kZerocopyMin) {
+  if (zcs && nsegs == 1 && payload_len >= kZerocopyMin) {
+    const void* payload = segs[0].iov_base;
     // Zerocopy experiment path: copy the tiny framing, pin the payload
     // pages. Completion is reaped before returning (synchronous — see
     // the block comment above ZerocopyEnabled).
@@ -410,6 +448,11 @@ bool Van::Send(int fd, const MsgHeader& head, const void* payload,
               zcs->next == 0 ||
               static_cast<int32_t>(zcs->reaped - (zcs->next - 1)) >= 0;
           if (nothing_pending) {
+            // Sustained ENOBUFS (general memory pressure) must not stall
+            // Van::Stop: bail out of the backoff loop once stop is
+            // requested instead of retrying forever under the per-fd
+            // send lock.
+            if (stop_.load()) return false;
             usleep(1000);
           } else if (!ReapZerocopy(fd, zcs->next - 1, &zcs->reaped,
                                    stop_)) {
@@ -426,34 +469,36 @@ bool Van::Send(int fd, const MsgHeader& head, const void* payload,
     // left started >= kZerocopyMin, so at least one send incremented next.
     return ReapZerocopy(fd, zcs->next - 1, &zcs->reaped, stop_);
   }
-  iovec iov[3];
+  // Gather write: framing words + every payload segment in one writev.
+  // Segments beyond IOV_MAX (or past a partial write) finish through the
+  // SendAll fallback loop below.
+  std::vector<iovec> iov(2 + static_cast<size_t>(nsegs));
   iov[0].iov_base = &total;
   iov[0].iov_len = sizeof(total);
   iov[1].iov_base = &h;
   iov[1].iov_len = sizeof(h);
-  iov[2].iov_base = const_cast<void*>(payload);
-  iov[2].iov_len = static_cast<size_t>(payload_len);
-  int iovcnt = payload_len > 0 ? 3 : 2;
-  // writev for the common case; fall back to SendAll on partial writes.
-  size_t want = sizeof(total) + sizeof(h) + (payload_len > 0 ? payload_len : 0);
+  int iovcnt = 2;
+  for (int i = 0; i < nsegs; ++i) {
+    if (segs[i].iov_len == 0) continue;
+    iov[iovcnt++] = segs[i];
+  }
+  size_t want = sizeof(total) + sizeof(h) + static_cast<size_t>(payload_len);
   bytes_sent_.fetch_add(static_cast<int64_t>(want),
                         std::memory_order_relaxed);
-  ssize_t n = ::writev(fd, iov, iovcnt);
+  int first_cnt = iovcnt > IOV_MAX ? IOV_MAX : iovcnt;
+  ssize_t n = ::writev(fd, iov.data(), first_cnt);
   if (n == static_cast<ssize_t>(want)) return true;
   if (n < 0) return false;
-  // Partial write: finish byte-by-byte from where writev stopped.
+  // Partial write (or clipped iov list): finish from where writev stopped.
   size_t done = static_cast<size_t>(n);
-  const char* bufs[3] = {reinterpret_cast<const char*>(&total),
-                         reinterpret_cast<const char*>(&h),
-                         static_cast<const char*>(payload)};
-  size_t lens[3] = {sizeof(total), sizeof(h),
-                    static_cast<size_t>(payload_len > 0 ? payload_len : 0)};
-  for (int i = 0; i < 3; ++i) {
-    if (done >= lens[i]) {
-      done -= lens[i];
+  for (int i = 0; i < iovcnt; ++i) {
+    if (done >= iov[i].iov_len) {
+      done -= iov[i].iov_len;
       continue;
     }
-    if (!SendAll(fd, bufs[i] + done, lens[i] - done)) return false;
+    if (!SendAll(fd, static_cast<const char*>(iov[i].iov_base) + done,
+                 iov[i].iov_len - done))
+      return false;
     done = 0;
   }
   return true;

@@ -19,6 +19,8 @@
 // both transports. Remote peers keep TCP — mixed fleets need no config.
 #pragma once
 
+#include <sys/uio.h>
+
 #include <atomic>
 #include <functional>
 #include <memory>
@@ -50,6 +52,14 @@ class Van {
   // written straight from `payload` (zero-copy gather write).
   bool Send(int fd, const MsgHeader& head, const void* payload = nullptr,
             int64_t payload_len = 0);
+
+  // Gather-send: one framed message whose payload is the concatenation of
+  // `nsegs` discontiguous segments (the fusion layer's sub-header table +
+  // sub-payloads), written via a single writev without staging copies.
+  // head.payload_len is set to the segment total. Same per-fd locking and
+  // transport selection as Send.
+  bool SendV(int fd, const MsgHeader& head, const struct iovec* segs,
+             int nsegs);
 
   void CloseConn(int fd);
   void Stop();

@@ -1,13 +1,19 @@
 #include "worker.h"
 
+#include <sys/uio.h>
+
 #include <chrono>
 #include <cstring>
+#include <map>
 
 #include "cpu_reducer.h"
 #include "logging.h"
 #include "metrics.h"
 
 namespace bps {
+
+thread_local std::vector<BytePSWorker::PushOp>* BytePSWorker::fusion_sink_ =
+    nullptr;
 
 int64_t NowUs() {
   return std::chrono::duration_cast<std::chrono::microseconds>(
@@ -16,11 +22,22 @@ int64_t NowUs() {
 }
 
 void BytePSWorker::Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
-                         int64_t credit_bytes, std::string default_comp,
+                         int64_t credit_bytes, int64_t fusion_bytes,
+                         int fusion_keys, std::string default_comp,
                          bool trace_on) {
   po_ = po;
   kv_ = kv;
   partition_bytes_ = partition_bytes;
+  fusion_bytes_ = fusion_bytes < 0 ? 0 : fusion_bytes;
+  fusion_keys_ = fusion_keys < 2 ? 2 : fusion_keys;
+  // Flush linger: how long the collector waits for the enqueuing thread
+  // to deliver the next fusible task before flushing a partial batch.
+  // Bounded per batch; small vs a framed round trip but long vs the
+  // enqueuer's per-task cadence, so batches actually form.
+  if (const char* lv = getenv("BYTEPS_FUSION_LINGER_US")) {
+    fusion_linger_us_ = atoll(lv);
+    if (fusion_linger_us_ < 0) fusion_linger_us_ = 0;
+  }
   default_comp_ = std::move(default_comp);
   trace_on_ = trace_on;
   // Pre-register the worker-side metric catalog: every stage's series
@@ -31,6 +48,8 @@ void BytePSWorker::Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
   Metrics::Get().Counter("bps_push_bytes_total");
   Metrics::Get().Counter("bps_push_partitions_total");
   Metrics::Get().Counter("bps_pull_bytes_total");
+  Metrics::Get().Counter("bps_fused_msgs_total");
+  Metrics::Get().Histogram("bps_fusion_batch_keys");
   Metrics::Get().Histogram("bps_push_us");
   Metrics::Get().Histogram("bps_pull_us");
   // Reference semantics: BYTEPS_SCHEDULING_CREDIT is an in-flight BYTE
@@ -87,7 +106,71 @@ void BytePSWorker::Stop() {
 
 void BytePSWorker::PushLoop() {
   Task t;
-  while (queue_->Pop(&t)) t.run();
+  while (queue_->Pop(&t)) {
+    if (fusion_bytes_ <= 0 || !t.fusible) {
+      t.run();
+      continue;
+    }
+    // Fusion collector: this (priority-ordered) pop opens a collect
+    // session. Fusible tasks keep popping — in priority order, for ANY
+    // server (the byte-balanced assignment interleaves servers at the
+    // queue head) — and accumulate into one batch per destination
+    // server. A server's batch flushes the moment it reaches the byte
+    // threshold (BYTEPS_FUSION_BYTES) or key cap (BYTEPS_FUSION_KEYS);
+    // the session ends — flushing every partial batch — when a
+    // non-fusible task reaches the queue head or the queue stays empty
+    // past the linger deadline (the enqueuing thread pumps tasks in
+    // slower than this thread drains them; without a short wait every
+    // batch degenerates to a singleton).
+    std::map<int, std::pair<std::vector<PushOp>, int64_t>> acc;
+    const int64_t deadline_us = NowUs() + fusion_linger_us_;
+    auto stage = [this, &acc](Task& task) {
+      auto& a = acc[task.server_id];
+      // One operation per key per frame: deep pipelining can enqueue
+      // rounds r and r+2 of one tensor back-to-back, and the server
+      // PARKS an r+2 sub-push until round r's pulls recycle its slot —
+      // pulls this batch would only issue after its own (parked-gated)
+      // ack. Two rounds of one key in one frame is therefore a
+      // self-deadlock; flush the batch and let the next frame carry the
+      // later round, exactly like the unfused wire.
+      for (const PushOp& prev : a.first) {
+        if (prev.p->key == task.key) {
+          FlushBatch(task.server_id, std::move(a.first));
+          a = {};
+          break;
+        }
+      }
+      fusion_sink_ = &a.first;
+      task.run();  // stages its PushOp via fusion_sink_
+      fusion_sink_ = nullptr;
+      a.second += task.bytes;
+      if (a.second >= fusion_bytes_ ||
+          static_cast<int>(a.first.size()) >= fusion_keys_) {
+        FlushBatch(task.server_id, std::move(a.first));
+        acc.erase(task.server_id);
+      }
+    };
+    stage(t);
+    Task more;
+    while (queue_->TryPopFusible(
+        std::max<int64_t>(0, deadline_us - NowUs()), &more)) {
+      stage(more);
+    }
+    for (auto& kv : acc) {
+      FlushBatch(kv.first, std::move(kv.second.first));
+    }
+  }
+}
+
+void BytePSWorker::FlushBatch(int server_id, std::vector<PushOp> ops) {
+  if (ops.empty()) return;
+  if (ops.size() == 1) {
+    // A batch of one gains nothing from the multi framing; keep the
+    // single-frame wire format (and its lower parse cost).
+    SendPush(std::move(ops[0]));
+    return;
+  }
+  SendFusedPush(server_id, std::move(ops));
 }
 
 void BytePSWorker::Record(int64_t key, const char* stage, int64_t start_us) {
@@ -213,135 +296,351 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
     task.priority = ctx->priority;
     task.key = p->key;
     task.bytes = p->len * esz;  // raw bytes charged against the credit
+    task.server_id = p->server_id;
+    // Fusible iff under the fusion threshold: a conv net's hundreds of
+    // sub-partition-size tensors coalesce; full partitions keep their
+    // own frames.
+    task.fusible = fusion_bytes_ > 0 && task.bytes < fusion_bytes_;
     task.run = [this, ctx, p, ptr, esz, version, scale, async_mode, handle] {
       char* base = static_cast<char*>(ptr) + p->offset * esz;
       int64_t raw_len = p->len * esz;
-      const void* payload = base;
-      int64_t payload_len = raw_len;
-      int flags = async_mode ? FLAG_ASYNC : 0;
+      PushOp op;
+      op.p = p;
+      op.ctx = ctx;
+      op.base = base;
+      op.raw_len = raw_len;
+      op.payload = base;
+      op.payload_len = raw_len;
+      op.flags = async_mode ? FLAG_ASYNC : 0;
+      op.version = version;
+      op.scale = scale;
+      op.handle = handle;
       int64_t t0 = NowUs();
       if (p->comp) {
         p->comp->Compress(reinterpret_cast<const float*>(base), p->len,
                           &p->comp_buf);
-        payload = p->comp_buf.data();
-        payload_len = static_cast<int64_t>(p->comp_buf.size());
-        flags |= FLAG_COMPRESSED;
+        op.payload = p->comp_buf.data();
+        op.payload_len = static_cast<int64_t>(p->comp_buf.size());
+        op.flags |= FLAG_COMPRESSED;
         Record(p->key, "compress", t0);
         BPS_METRIC_HISTO_OBSERVE("bps_compress_us", NowUs() - t0);
         BPS_METRIC_COUNTER_ADD("bps_compress_in_bytes_total", raw_len);
-        BPS_METRIC_COUNTER_ADD("bps_compress_out_bytes_total", payload_len);
+        BPS_METRIC_COUNTER_ADD("bps_compress_out_bytes_total",
+                               op.payload_len);
       }
-      MsgHeader h{};
-      h.cmd = CMD_PUSH;
-      h.key = p->key;
-      h.dtype = ctx->dtype;
-      h.version = version;
-      h.flags = flags;
-      h.arg0 = raw_len;
-      int64_t t_push = NowUs();
-      // Wire-byte parity contract with the server's bps_recv_bytes_total
-      // (docs/monitoring.md): both sides count CMD_PUSH payload bytes —
-      // compressed size when a codec is on — so worker-side push totals
-      // and server-side recv totals sum to the same number fleet-wide.
-      BPS_METRIC_COUNTER_ADD("bps_push_bytes_total", payload_len);
-      BPS_METRIC_COUNTER_ADD("bps_push_partitions_total", 1);
-      kv_->Request(
-          p->server_id, h, payload, payload_len,
-          [this, ctx, p, base, raw_len, version, scale, flags, handle,
-           t_push](Message&& ack) {
-            if (ack.head.cmd == CMD_ERROR) {
-              // Dead server: fail the handle now with the diagnostic
-              // instead of blocking Wait until the heartbeat detector.
-              FailHandle(handle, p->key, std::move(ack));
-              queue_->ReleaseCredit(raw_len);
-              return;
-            }
-            if (QueueDebug())
-              fprintf(stderr, "[QDEBUG] push_ack key=%lld\n",
-                      (long long)p->key);
-            Record(p->key, "push", t_push);
-            BPS_METRIC_HISTO_OBSERVE("bps_push_us", NowUs() - t_push);
-            // Async: the ack carries the server's fleet-wide apply count
-            // for this key as of OUR push; the pull resp carries it as
-            // of the pull. Their difference is this pull's staleness.
-            int64_t at_push = ack.head.arg1;
-            // Push acknowledged -> issue the pull for the aggregate.
-            MsgHeader ph{};
-            ph.cmd = CMD_PULL;
-            ph.key = p->key;
-            ph.dtype = ctx->dtype;
-            ph.version = version;
-            ph.flags = flags & FLAG_ASYNC;
-            int64_t t_pull = NowUs();
-            kv_->Request(
-                p->server_id, ph, nullptr, 0,
-                [this, ctx, p, base, raw_len, scale, handle, t_pull,
-                 flags, at_push](Message&& resp) {
-                  if (resp.head.cmd == CMD_ERROR) {
-                    FailHandle(handle, p->key, std::move(resp));
-                    queue_->ReleaseCredit(raw_len);
-                    return;
-                  }
-                  if (QueueDebug())
-                    fprintf(stderr, "[QDEBUG] pull_resp key=%lld\n",
-                            (long long)p->key);
-                  Record(p->key, "pull", t_pull);
-                  BPS_METRIC_HISTO_OBSERVE("bps_pull_us", NowUs() - t_pull);
-                  BPS_METRIC_COUNTER_ADD(
-                      "bps_pull_bytes_total",
-                      static_cast<int64_t>(resp.payload.size()));
-                  if (flags & FLAG_ASYNC) {
-                    int64_t stale = resp.head.arg1 - at_push;
-                    if (stale >= 0) {  // peers' pushes applied between
-                      stale_sum_.fetch_add(stale,
-                                           std::memory_order_relaxed);
-                      stale_n_.fetch_add(1, std::memory_order_relaxed);
-                      int64_t cur =
-                          stale_max_.load(std::memory_order_relaxed);
-                      while (stale > cur &&
-                             !stale_max_.compare_exchange_weak(
-                                 cur, stale, std::memory_order_relaxed)) {
-                      }
-                    }
-                  }
-                  if (resp.head.flags & FLAG_COMPRESSED) {
-                    // Pull-leg compression: the server re-encoded the
-                    // aggregate with this key's codec (SURVEY.md §2.2
-                    // server symmetry); decode straight into the
-                    // caller's buffer.
-                    BPS_CHECK(p->comp)
-                        << "compressed pull but no codec, key " << p->key;
-                    BPS_CHECK_EQ(resp.head.arg0, raw_len)
-                        << "pull length mismatch for key " << p->key;
-                    int64_t t_dec = NowUs();
-                    p->comp->Decompress(
-                        resp.payload.data(),
-                        static_cast<int64_t>(resp.payload.size()),
-                        reinterpret_cast<float*>(base), p->len);
-                    BPS_METRIC_HISTO_OBSERVE("bps_decompress_us",
-                                             NowUs() - t_dec);
-                  } else {
-                    BPS_CHECK_EQ(
-                        static_cast<int64_t>(resp.payload.size()), raw_len)
-                        << "pull length mismatch for key " << p->key;
-                    memcpy(base, resp.payload.data(), raw_len);
-                  }
-                  if (scale != 1.0) {
-                    CpuReducer::Scale(base, scale, raw_len, ctx->dtype);
-                  }
-                  queue_->ReleaseCredit(raw_len);
-                  if (handle->remaining.fetch_sub(1) == 1) {
-                    std::lock_guard<std::mutex> lk2(mu_);
-                    cv_.notify_all();
-                  }
-                });
-          });
+      if (fusion_sink_ != nullptr) {
+        // PushLoop is assembling a fused frame: stage, don't send.
+        fusion_sink_->push_back(std::move(op));
+        return;
+      }
+      SendPush(std::move(op));
     };
     BPS_METRIC_COUNTER_ADD("bps_partitions_enqueued_total", 1);
     BPS_METRIC_COUNTER_ADD("bps_enqueued_bytes_total", task.bytes);
     queue_->Push(std::move(task));
   }
   return handle_id;
+}
+
+void BytePSWorker::SendPush(PushOp op) {
+  Part* p = op.p;
+  TensorCtx* ctx = op.ctx;
+  char* base = op.base;
+  int64_t raw_len = op.raw_len;
+  int flags = op.flags;
+  int version = op.version;
+  double scale = op.scale;
+  std::shared_ptr<Handle> handle = op.handle;
+  MsgHeader h{};
+  h.cmd = CMD_PUSH;
+  h.key = p->key;
+  h.dtype = ctx->dtype;
+  h.version = version;
+  h.flags = flags;
+  h.arg0 = raw_len;
+  int64_t t_push = NowUs();
+  // Wire-byte parity contract with the server's bps_recv_bytes_total
+  // (docs/monitoring.md): both sides count CMD_PUSH payload bytes —
+  // compressed size when a codec is on — so worker-side push totals
+  // and server-side recv totals sum to the same number fleet-wide.
+  BPS_METRIC_COUNTER_ADD("bps_push_bytes_total", op.payload_len);
+  BPS_METRIC_COUNTER_ADD("bps_push_partitions_total", 1);
+  kv_->Request(
+      p->server_id, h, op.payload, op.payload_len,
+      [this, ctx, p, base, raw_len, version, scale, flags, handle,
+       t_push](Message&& ack) {
+        if (ack.head.cmd == CMD_ERROR) {
+          // Dead server: fail the handle now with the diagnostic
+          // instead of blocking Wait until the heartbeat detector.
+          FailHandle(handle, p->key, std::move(ack));
+          queue_->ReleaseCredit(raw_len);
+          return;
+        }
+        if (QueueDebug())
+          fprintf(stderr, "[QDEBUG] push_ack key=%lld\n",
+                  (long long)p->key);
+        Record(p->key, "push", t_push);
+        BPS_METRIC_HISTO_OBSERVE("bps_push_us", NowUs() - t_push);
+        // Async: the ack carries the server's fleet-wide apply count
+        // for this key as of OUR push; the pull resp carries it as
+        // of the pull. Their difference is this pull's staleness.
+        int64_t at_push = ack.head.arg1;
+        // Push acknowledged -> issue the pull for the aggregate.
+        MsgHeader ph{};
+        ph.cmd = CMD_PULL;
+        ph.key = p->key;
+        ph.dtype = ctx->dtype;
+        ph.version = version;
+        ph.flags = flags & FLAG_ASYNC;
+        int64_t t_pull = NowUs();
+        kv_->Request(
+            p->server_id, ph, nullptr, 0,
+            [this, ctx, p, base, raw_len, scale, handle, t_pull,
+             flags, at_push](Message&& resp) {
+              if (resp.head.cmd == CMD_ERROR) {
+                FailHandle(handle, p->key, std::move(resp));
+                queue_->ReleaseCredit(raw_len);
+                return;
+              }
+              if (QueueDebug())
+                fprintf(stderr, "[QDEBUG] pull_resp key=%lld\n",
+                        (long long)p->key);
+              Record(p->key, "pull", t_pull);
+              BPS_METRIC_HISTO_OBSERVE("bps_pull_us", NowUs() - t_pull);
+              BPS_METRIC_COUNTER_ADD(
+                  "bps_pull_bytes_total",
+                  static_cast<int64_t>(resp.payload.size()));
+              if (flags & FLAG_ASYNC) {
+                int64_t stale = resp.head.arg1 - at_push;
+                if (stale >= 0) {  // peers' pushes applied between
+                  stale_sum_.fetch_add(stale,
+                                       std::memory_order_relaxed);
+                  stale_n_.fetch_add(1, std::memory_order_relaxed);
+                  int64_t cur =
+                      stale_max_.load(std::memory_order_relaxed);
+                  while (stale > cur &&
+                         !stale_max_.compare_exchange_weak(
+                             cur, stale, std::memory_order_relaxed)) {
+                  }
+                }
+              }
+              if (resp.head.flags & FLAG_COMPRESSED) {
+                // Pull-leg compression: the server re-encoded the
+                // aggregate with this key's codec (SURVEY.md §2.2
+                // server symmetry); decode straight into the
+                // caller's buffer.
+                BPS_CHECK(p->comp)
+                    << "compressed pull but no codec, key " << p->key;
+                BPS_CHECK_EQ(resp.head.arg0, raw_len)
+                    << "pull length mismatch for key " << p->key;
+                int64_t t_dec = NowUs();
+                p->comp->Decompress(
+                    resp.payload.data(),
+                    static_cast<int64_t>(resp.payload.size()),
+                    reinterpret_cast<float*>(base), p->len);
+                BPS_METRIC_HISTO_OBSERVE("bps_decompress_us",
+                                         NowUs() - t_dec);
+              } else {
+                BPS_CHECK_EQ(
+                    static_cast<int64_t>(resp.payload.size()), raw_len)
+                    << "pull length mismatch for key " << p->key;
+                memcpy(base, resp.payload.data(), raw_len);
+              }
+              if (scale != 1.0) {
+                CpuReducer::Scale(base, scale, raw_len, ctx->dtype);
+              }
+              queue_->ReleaseCredit(raw_len);
+              if (handle->remaining.fetch_sub(1) == 1) {
+                std::lock_guard<std::mutex> lk2(mu_);
+                cv_.notify_all();
+              }
+            });
+      });
+}
+
+// Validate a CMD_MULTI_* reply frame and return its sub-header table;
+// *gathered points at the payload region behind the table.
+static const SubHeader* ParseMultiReply(const Message& m, int expect_cmd,
+                                        int expect_n,
+                                        const char** gathered) {
+  BPS_CHECK_EQ(m.head.cmd, expect_cmd)
+      << "unexpected reply cmd for fused frame";
+  BPS_CHECK_EQ(static_cast<int>(m.head.arg0), expect_n)
+      << "fused reply count mismatch";
+  int64_t table_bytes =
+      static_cast<int64_t>(expect_n) * static_cast<int64_t>(sizeof(SubHeader));
+  BPS_CHECK_GE(static_cast<int64_t>(m.payload.size()), table_bytes)
+      << "fused reply shorter than its table";
+  *gathered = m.payload.data() + table_bytes;
+  return reinterpret_cast<const SubHeader*>(m.payload.data());
+}
+
+void BytePSWorker::SendFusedPush(int server_id, std::vector<PushOp> ops) {
+  const int n = static_cast<int>(ops.size());
+  auto batch = std::make_shared<std::vector<PushOp>>(std::move(ops));
+  std::vector<SubHeader> table(static_cast<size_t>(n));
+  std::vector<iovec> segs;
+  segs.reserve(static_cast<size_t>(n) + 1);
+  segs.push_back({table.data(),
+                  static_cast<size_t>(n) * sizeof(SubHeader)});
+  int64_t off = 0, wire_bytes = 0;
+  for (int i = 0; i < n; ++i) {
+    PushOp& op = (*batch)[i];
+    SubHeader& s = table[i];
+    s.key = op.p->key;
+    s.cmd = CMD_PUSH;
+    s.version = op.version;
+    s.dtype = op.ctx->dtype;
+    s.flags = op.flags;
+    s.arg0 = op.raw_len;
+    s.offset = off;
+    s.len = op.payload_len;
+    off += op.payload_len;
+    wire_bytes += op.payload_len;
+    if (op.payload_len > 0) {
+      segs.push_back({const_cast<void*>(op.payload),
+                      static_cast<size_t>(op.payload_len)});
+    }
+  }
+  MsgHeader h{};
+  h.cmd = CMD_MULTI_PUSH;
+  h.key = table[0].key;  // stripes/routes the batch like its lead key
+  h.arg0 = n;
+  // Parity contract unchanged under fusion: both sides count the SUB
+  // payload bytes (the table is framing, like headers).
+  BPS_METRIC_COUNTER_ADD("bps_push_bytes_total", wire_bytes);
+  BPS_METRIC_COUNTER_ADD("bps_push_partitions_total", n);
+  BPS_METRIC_COUNTER_ADD("bps_fused_msgs_total", 1);
+  BPS_METRIC_HISTO_OBSERVE("bps_fusion_batch_keys", n);
+  int64_t t_push = NowUs();
+  // The table and iovec list live only until RequestV returns — the van
+  // writes synchronously; the payload segments themselves live in caller
+  // buffers / comp_bufs until the handles settle.
+  kv_->RequestV(server_id, h, segs.data(), static_cast<int>(segs.size()),
+                [this, server_id, batch, t_push](Message&& ack) {
+                  OnFusedAck(server_id, batch, t_push, std::move(ack));
+                });
+}
+
+void BytePSWorker::OnFusedAck(
+    int server_id, const std::shared_ptr<std::vector<PushOp>>& batch,
+    int64_t t_push, Message&& ack) {
+  if (ack.head.cmd == CMD_ERROR) {
+    FailBatch(batch, std::move(ack));
+    return;
+  }
+  const int n = static_cast<int>(batch->size());
+  const char* gathered = nullptr;
+  const SubHeader* subs = ParseMultiReply(ack, CMD_MULTI_ACK, n, &gathered);
+  auto at_push = std::make_shared<std::vector<int64_t>>(
+      static_cast<size_t>(n), 0);
+  std::vector<SubHeader> table(static_cast<size_t>(n));
+  for (int i = 0; i < n; ++i) {
+    PushOp& op = (*batch)[i];
+    BPS_CHECK_EQ(subs[i].key, op.p->key) << "fused ack table out of order";
+    if (QueueDebug())
+      fprintf(stderr, "[QDEBUG] push_ack key=%lld\n",
+              (long long)op.p->key);
+    Record(op.p->key, "push", t_push);
+    BPS_METRIC_HISTO_OBSERVE("bps_push_us", NowUs() - t_push);
+    (*at_push)[i] = subs[i].arg1;  // async apply count as of our push
+    SubHeader& s = table[i];
+    s.key = op.p->key;
+    s.cmd = CMD_PULL;
+    s.version = op.version;
+    s.dtype = op.ctx->dtype;
+    s.flags = op.flags & FLAG_ASYNC;
+  }
+  // Whole batch acknowledged -> one fused pull for the aggregates.
+  MsgHeader h{};
+  h.cmd = CMD_MULTI_PULL;
+  h.key = table[0].key;
+  h.arg0 = n;
+  iovec seg{table.data(), static_cast<size_t>(n) * sizeof(SubHeader)};
+  int64_t t_pull = NowUs();
+  kv_->RequestV(server_id, h, &seg, 1,
+                [this, batch, at_push, t_pull](Message&& resp) {
+                  OnFusedPullResp(batch, at_push, t_pull, std::move(resp));
+                });
+}
+
+void BytePSWorker::OnFusedPullResp(
+    const std::shared_ptr<std::vector<PushOp>>& batch,
+    const std::shared_ptr<std::vector<int64_t>>& at_push, int64_t t_pull,
+    Message&& resp) {
+  if (resp.head.cmd == CMD_ERROR) {
+    FailBatch(batch, std::move(resp));
+    return;
+  }
+  const int n = static_cast<int>(batch->size());
+  const char* gathered = nullptr;
+  const SubHeader* subs =
+      ParseMultiReply(resp, CMD_MULTI_PULL_RESP, n, &gathered);
+  int64_t gathered_len = static_cast<int64_t>(resp.payload.size()) -
+                         static_cast<int64_t>(n) *
+                             static_cast<int64_t>(sizeof(SubHeader));
+  for (int i = 0; i < n; ++i) {
+    PushOp& op = (*batch)[i];
+    const SubHeader& s = subs[i];
+    BPS_CHECK_EQ(s.key, op.p->key) << "fused pull table out of order";
+    BPS_CHECK(s.offset >= 0 && s.len >= 0 &&
+              s.offset + s.len <= gathered_len)
+        << "fused pull sub-payload out of range, key " << s.key;
+    if (QueueDebug())
+      fprintf(stderr, "[QDEBUG] pull_resp key=%lld\n",
+              (long long)op.p->key);
+    Record(op.p->key, "pull", t_pull);
+    BPS_METRIC_HISTO_OBSERVE("bps_pull_us", NowUs() - t_pull);
+    BPS_METRIC_COUNTER_ADD("bps_pull_bytes_total", s.len);
+    if (op.flags & FLAG_ASYNC) {
+      int64_t stale = s.arg1 - (*at_push)[i];
+      if (stale >= 0) {  // peers' pushes applied between
+        stale_sum_.fetch_add(stale, std::memory_order_relaxed);
+        stale_n_.fetch_add(1, std::memory_order_relaxed);
+        int64_t cur = stale_max_.load(std::memory_order_relaxed);
+        while (stale > cur &&
+               !stale_max_.compare_exchange_weak(
+                   cur, stale, std::memory_order_relaxed)) {
+        }
+      }
+    }
+    const char* data = gathered + s.offset;
+    if (s.flags & FLAG_COMPRESSED) {
+      // Pull-leg compression, per sub-entry (server symmetry as in the
+      // single-frame path).
+      BPS_CHECK(op.p->comp)
+          << "compressed pull but no codec, key " << op.p->key;
+      BPS_CHECK_EQ(s.arg0, op.raw_len)
+          << "pull length mismatch for key " << op.p->key;
+      int64_t t_dec = NowUs();
+      op.p->comp->Decompress(data, s.len,
+                             reinterpret_cast<float*>(op.base), op.p->len);
+      BPS_METRIC_HISTO_OBSERVE("bps_decompress_us", NowUs() - t_dec);
+    } else {
+      BPS_CHECK_EQ(s.len, op.raw_len)
+          << "pull length mismatch for key " << op.p->key;
+      memcpy(op.base, data, static_cast<size_t>(op.raw_len));
+    }
+    if (op.scale != 1.0) {
+      CpuReducer::Scale(op.base, op.scale, op.raw_len, op.ctx->dtype);
+    }
+    queue_->ReleaseCredit(op.raw_len);
+    if (op.handle->remaining.fetch_sub(1) == 1) {
+      std::lock_guard<std::mutex> lk2(mu_);
+      cv_.notify_all();
+    }
+  }
+}
+
+void BytePSWorker::FailBatch(
+    const std::shared_ptr<std::vector<PushOp>>& batch, Message&& err) {
+  for (PushOp& op : *batch) {
+    Message e;
+    e.head = err.head;
+    e.payload.assign(err.payload.begin(), err.payload.end());
+    FailHandle(op.handle, op.p->key, std::move(e));
+    queue_->ReleaseCredit(op.raw_len);
+  }
 }
 
 int BytePSWorker::Broadcast(int64_t tensor_id, void* ptr, int64_t nelem,

@@ -40,8 +40,13 @@ struct TraceEvent {
 
 class BytePSWorker {
  public:
+  // fusion_bytes: partitions with raw size under this are eligible for
+  // small-tensor fusion (coalesced CMD_MULTI_PUSH frames); 0 disables —
+  // the wire protocol is then byte-for-byte the unfused one.
+  // fusion_keys: max sub-operations per fused frame.
   void Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
-             int64_t credit_bytes, std::string default_comp, bool trace_on);
+             int64_t credit_bytes, int64_t fusion_bytes, int fusion_keys,
+             std::string default_comp, bool trace_on);
   void Stop();
   // Cumulative async-pull staleness stats (see stale_* members).
   void StalenessStats(long long* sum, long long* max_out,
@@ -120,17 +125,61 @@ class BytePSWorker {
     explicit Handle(int n) : remaining(n) {}
   };
 
+  // One wire-ready push staged by a scheduled-queue task: everything the
+  // send path needs after compression ran. `payload` points into the
+  // caller's buffer or the partition's comp_buf — both stay alive until
+  // the handle settles, so fused sends may gather them without copies.
+  struct PushOp {
+    Part* p = nullptr;
+    TensorCtx* ctx = nullptr;
+    char* base = nullptr;  // caller buffer slice (pull destination)
+    int64_t raw_len = 0;
+    const void* payload = nullptr;
+    int64_t payload_len = 0;
+    int flags = 0;
+    int version = 0;
+    double scale = 1.0;
+    std::shared_ptr<Handle> handle;
+  };
+
   void PushLoop();
   void Record(int64_t key, const char* stage, int64_t start_us);
   // Mark a handle failed with the CMD_ERROR diagnostic and complete it.
   void FailHandle(const std::shared_ptr<Handle>& handle, int64_t key,
                   Message&& err);
+  // Single-frame send: CMD_PUSH, chained CMD_PULL from the ack callback
+  // (the pre-fusion hot path, unchanged semantics).
+  void SendPush(PushOp op);
+  // Collector flush: singletons keep the single-frame wire format,
+  // anything larger goes out as one fused frame.
+  void FlushBatch(int server_id, std::vector<PushOp> ops);
+  // Fused send: one CMD_MULTI_PUSH frame for the whole batch, one
+  // batched ack, one CMD_MULTI_PULL, one batched response.
+  void SendFusedPush(int server_id, std::vector<PushOp> ops);
+  void OnFusedAck(int server_id,
+                  const std::shared_ptr<std::vector<PushOp>>& batch,
+                  int64_t t_push, Message&& ack);
+  void OnFusedPullResp(const std::shared_ptr<std::vector<PushOp>>& batch,
+                       const std::shared_ptr<std::vector<int64_t>>& at_push,
+                       int64_t t_pull, Message&& resp);
+  // Fail every handle in the batch with the CMD_ERROR diagnostic and
+  // release its credits.
+  void FailBatch(const std::shared_ptr<std::vector<PushOp>>& batch,
+                 Message&& err);
 
   Postoffice* po_ = nullptr;
   KVWorker* kv_ = nullptr;
   int64_t partition_bytes_ = 4096000;
+  int64_t fusion_bytes_ = 0;  // 0 = fusion off
+  int fusion_keys_ = 128;
+  int64_t fusion_linger_us_ = 200;  // BYTEPS_FUSION_LINGER_US
   std::string default_comp_;
   bool trace_on_ = false;
+
+  // Fusion collector: while a PushLoop thread assembles a batch, its
+  // tasks stage PushOps here instead of sending (thread-local — each
+  // push thread batches independently).
+  static thread_local std::vector<PushOp>* fusion_sink_;
 
   std::mutex mu_;
   std::condition_variable cv_;

@@ -142,6 +142,9 @@ def _apply_config_env(cfg: Optional[Config]) -> None:
     os.environ["DMLC_NUM_SERVER"] = str(cfg.num_server)
     os.environ["BYTEPS_PARTITION_BYTES"] = str(cfg.partition_bytes)
     os.environ["BYTEPS_SCHEDULING_CREDIT"] = str(cfg.scheduling_credit)
+    os.environ["BYTEPS_FUSION_BYTES"] = str(cfg.fusion_bytes)
+    os.environ["BYTEPS_FUSION_KEYS"] = str(cfg.fusion_keys)
+    os.environ["BYTEPS_FUSION_LINGER_US"] = str(cfg.fusion_linger_us)
     os.environ["BYTEPS_SERVER_ENGINE_THREAD"] = str(cfg.server_engine_threads)
     os.environ["BYTEPS_ENABLE_ASYNC"] = "1" if cfg.enable_async else "0"
     if cfg.compressor:

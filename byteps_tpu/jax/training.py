@@ -156,8 +156,6 @@ def make_async_train_step(
     (params, opt_state, loss)`` where the returned params are the freshly
     pulled server state.
     """
-    import numpy as np
-
     from byteps_tpu.jax.ps import ps_broadcast
 
     st = bps._st()
@@ -179,11 +177,18 @@ def make_async_train_step(
         return updates, opt_state, loss
 
     leaves0, treedef = jax.tree_util.tree_flatten(params)
-    tids = [client.declare(f"{prefix}_{i}", leaf.size,
-                           np.dtype(leaf.dtype).name)
-            for i, leaf in enumerate(leaves0)]
+    # Wire keys MUST be the ones ps_broadcast seeded: _tids derives the
+    # same `{prefix}_{crc32:08x}_{i}` names (and hits its cache, since
+    # the broadcast above registered this exact tree). Declaring bare
+    # `{prefix}_{i}` here instead would push the deltas to fresh,
+    # never-initialised server keys — the first delta would silently
+    # BECOME the parameters instead of updating them.
+    from byteps_tpu.jax.ps import (_as_arrays, _codec_active, _tids,
+                                   _wait_all, _wire_plan, _writable)
 
-    from byteps_tpu.jax.ps import _wait_all, _writable
+    plan_leaves = _as_arrays(leaves0)
+    tids = _tids(client, prefix, plan_leaves,
+                 _wire_plan(plan_leaves, _codec_active(st)))
 
     def step(params, opt_state, batch):
         updates, opt_state, loss = local_update(params, opt_state, batch)

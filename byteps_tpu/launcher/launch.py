@@ -230,6 +230,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "every role serves /metrics + /healthz on "
                         "BASE + its node id; scrape with "
                         "`python -m byteps_tpu.monitor.top`")
+    p.add_argument("--fusion-bytes", type=int, metavar="N", default=-1,
+                   help="small-tensor fusion threshold for the whole "
+                        "fleet (BYTEPS_FUSION_BYTES): partitions under N "
+                        "raw bytes coalesce into multi-key wire frames; "
+                        "0 disables fusion (default: inherit env, 65536)")
     p.add_argument("--restarts", type=int, default=0,
                    help="--local mode: relaunch the whole fleet up to N "
                         "times after a failed run (elastic-ish recovery: "
@@ -244,6 +249,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.monitor_port:
         os.environ["BYTEPS_MONITOR_ON"] = "1"
         os.environ["BYTEPS_MONITOR_PORT"] = str(args.monitor_port)
+    if args.fusion_bytes >= 0:
+        os.environ["BYTEPS_FUSION_BYTES"] = str(args.fusion_bytes)
 
     if args.local:
         if not command:

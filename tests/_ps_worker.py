@@ -32,6 +32,8 @@ def main() -> int:
         return jax_overlap_accum_main()
     if mode == "jax_async":
         return jax_async_main()
+    if mode == "jax_async_seed":
+        return jax_async_seed_main()
     if mode == "jax_bucketed":
         return jax_bucketed_main()
     w = Worker.start()
@@ -504,6 +506,75 @@ def main() -> int:
             while go and not os.path.exists(go) and time.time() < deadline:
                 time.sleep(0.2)
 
+        elif mode == "fusion":
+            # Small-tensor fusion acceptance: a conv-net-shaped flood of
+            # tiny tensors must aggregate EXACTLY (integer-valued floats,
+            # so float summation is exact and the digest is bitwise
+            # comparable across fusion-on and fusion-off runs), and the
+            # worker/server push-byte parity contract must hold under
+            # fusion. Emits this worker's digest and wire counters; the
+            # parent test diffs them between runs.
+            import hashlib
+            import json
+            import urllib.request
+
+            from byteps_tpu.monitor.metrics import parse_prometheus
+
+            sizes = [64, 96, 128, 192, 256, 384, 512, 768, 1024, 1536,
+                     2048, 3072] * 8  # 96 tensors, 256 B .. 12 KiB
+            tids = [w.declare(f"fu{i}", n, "float32", compression="")
+                    for i, n in enumerate(sizes)]
+            digest = hashlib.sha256()
+            rounds = 3
+            for rnd in range(rounds):
+                staged = []
+                for i, (tid, n) in enumerate(zip(tids, sizes)):
+                    base = (np.arange(n) % 97 + i + rnd).astype(np.float32)
+                    arr = np.ascontiguousarray(base * (rank + 1))
+                    staged.append((tid, arr, base))
+                # Enqueue everything before waiting: the backlog is what
+                # the fusion collector coalesces.
+                handles = [(w.push_pull(t, a, average=False), a, b)
+                           for t, a, b in staged]
+                for h, a, base in handles:
+                    w.wait(h)
+                    expect = base * sum(r + 1 for r in range(nw))
+                    np.testing.assert_array_equal(a, expect)
+                    digest.update(a.tobytes())
+            w.barrier(GROUP_WORKERS)  # all counters final before scraping
+            snap = w.metrics_snapshot()["counters"]
+            parity = None
+            mport = int(os.environ.get("BYTEPS_MONITOR_PORT", "0"))
+            if rank == 0 and mport:
+                ns = int(os.environ["DMLC_NUM_SERVER"])
+
+                def scrape(port):
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/metrics",
+                            timeout=5) as r:
+                        return parse_prometheus(r.read().decode())
+
+                worker_push = sum(
+                    scrape(mport + 1 + ns + r)["bps_push_bytes_total"][()]
+                    for r in range(nw))
+                server_recv = sum(
+                    scrape(mport + 1 + s)["bps_recv_bytes_total"][()]
+                    for s in range(ns))
+                assert worker_push == server_recv, (worker_push,
+                                                    server_recv)
+                parity = [worker_push, server_recv]
+            print(json.dumps({
+                "digest": digest.hexdigest(),
+                "fused": snap.get("bps_fused_msgs_total", 0),
+                "frames": snap.get("bps_van_sent_frames_total", 0),
+                "push_partitions": snap.get("bps_push_partitions_total",
+                                            0),
+                "push_bytes": snap.get("bps_push_bytes_total", 0),
+                "parity": parity,
+            }), flush=True)
+            # Hold the fleet until rank 0 finished scraping everyone.
+            w.barrier(GROUP_WORKERS)
+
         elif mode == "barrier":
             w.barrier(GROUP_WORKERS)
             print(f"rank {rank} passed barrier")
@@ -624,6 +695,50 @@ def jax_async_main() -> int:
             last = float(loss)
         assert last < first * 0.2, (first, last)
         print(f"worker {rank}: jax_async OK ({first:.4f} -> {last:.4f})")
+        return 0
+    finally:
+        bps_jax.shutdown()
+
+
+def jax_async_seed_main() -> int:
+    """Regression for the async seeding key mismatch: make_async_train_step
+    seeds the server copy via ps_broadcast's `{prefix}_{crc32:08x}_{i}`
+    wire keys, and the step's delta pushes MUST land on those same keys.
+    With the old bare `{prefix}_{i}` declares the first delta silently
+    BECAME the parameters: one SGD step from w=1.0 with grad -4 and
+    lr 0.1 returned 0.4 instead of 1.4."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    import byteps_tpu.jax as bps_jax
+    from byteps_tpu.config import get_config
+
+    cfg = get_config(reload=True)
+    assert cfg.use_ps and cfg.enable_async
+    bps_jax.init()
+    try:
+        from byteps_tpu.jax.training import make_async_train_step
+
+        rank = bps_jax._st().ps_client.worker_rank()
+
+        def loss_fn(params, batch):
+            # d(loss)/dw == -4 everywhere; batch is just along for the API
+            return -4.0 * jnp.sum(params["w"]) + 0.0 * jnp.sum(batch)
+
+        params = {"w": jnp.asarray([1.0], jnp.float32)}
+        tx = optax.sgd(0.1)
+        params, step = make_async_train_step(loss_fn, tx, params)
+        opt_state = tx.init(params)
+        np.testing.assert_allclose(np.asarray(params["w"]), 1.0)
+        params, opt_state, _ = step(params, opt_state,
+                                    jnp.zeros((1,), jnp.float32))
+        got = float(np.asarray(params["w"])[0])
+        assert abs(got - 1.4) < 1e-6, (
+            f"async step from w=1.0, grad -4, lr 0.1 must pull 1.4 "
+            f"(seeded params + delta); got {got} — the delta keys missed "
+            "the broadcast-seeded server tensors")
+        print(f"worker {rank}: jax_async_seed OK (w=1.0 -> {got})")
         return 0
     finally:
         bps_jax.shutdown()

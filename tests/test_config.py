@@ -55,6 +55,35 @@ def test_env_parity_names(monkeypatch):
     assert cfg.log_level == "DEBUG"
 
 
+def test_fusion_defaults_and_env(monkeypatch):
+    """Small-tensor fusion knobs (ISSUE 2): sensible defaults, env
+    override, and 0 as the documented off switch."""
+    for var in ("BYTEPS_FUSION_BYTES", "BYTEPS_FUSION_KEYS",
+                "BYTEPS_FUSION_LINGER_US"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = load_config()
+    assert cfg.fusion_bytes == 65536
+    assert cfg.fusion_keys == 128
+    assert cfg.fusion_linger_us == 200
+    monkeypatch.setenv("BYTEPS_FUSION_BYTES", "0")  # fusion off
+    monkeypatch.setenv("BYTEPS_FUSION_KEYS", "32")
+    monkeypatch.setenv("BYTEPS_FUSION_LINGER_US", "0")
+    cfg = load_config()
+    assert cfg.fusion_bytes == 0
+    assert cfg.fusion_keys == 32
+    assert cfg.fusion_linger_us == 0
+
+
+def test_fusion_validation():
+    with pytest.raises(ValueError, match="BYTEPS_FUSION_BYTES"):
+        Config(fusion_bytes=-1).validate()
+    with pytest.raises(ValueError, match="BYTEPS_FUSION_KEYS"):
+        Config(fusion_keys=1).validate()
+    with pytest.raises(ValueError, match="BYTEPS_FUSION_LINGER_US"):
+        Config(fusion_linger_us=-5).validate()
+    Config(fusion_bytes=0).validate()  # 0 = off is legal
+
+
 def test_invalid_role():
     with pytest.raises(ValueError):
         Config(role="bogus").validate()

@@ -240,6 +240,70 @@ def test_async_mode():
                  extra={"BYTEPS_ENABLE_ASYNC": "1"})
 
 
+def _run_fusion_topology(fusion_bytes: int):
+    """One 2-worker x 2-server many-small-tensor run; returns the workers'
+    result rows (digest + wire counters; parity asserted in-worker)."""
+    import json
+    import random
+    import socket
+
+    # A base port with 5 consecutive free ports (scheduler + 2 servers +
+    # 2 workers serve /metrics on base + node_id).
+    rng = random.Random()
+    base = None
+    for _ in range(50):
+        cand = rng.randrange(20000, 55000)
+        socks = []
+        try:
+            for i in range(5):
+                s = socket.socket()
+                s.bind(("127.0.0.1", cand + i))
+                socks.append(s)
+            base = cand
+            break
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    assert base is not None, "no free port block found"
+    outs = run_topology(2, 2, WORKER, mode="fusion",
+                        extra={"BYTEPS_FUSION_BYTES": str(fusion_bytes),
+                               "BYTEPS_MONITOR_ON": "1",
+                               "BYTEPS_MONITOR_PORT": str(base)})
+    rows = [json.loads(ln) for o in outs for ln in o.splitlines()
+            if ln.startswith("{")]
+    assert len(rows) == 2, outs
+    return rows
+
+
+def test_fusion_on_off_bit_identical_and_fewer_frames():
+    """Small-tensor fusion acceptance (ISSUE 2): on a many-small-tensor
+    workload over 2 workers x 2 servers, fusion on vs off must produce
+    BIT-IDENTICAL aggregates (exact integer-valued floats, digests
+    compared across runs), a monotone wire-frame reduction (scraped via
+    bps_fused_msgs_total / bps_van_sent_frames_total), and the
+    worker/server push-byte parity contract must hold under fusion
+    (asserted in-worker over real /metrics scrapes)."""
+    on = _run_fusion_topology(65536)
+    off = _run_fusion_topology(0)
+    # Same aggregates, bit for bit, on every worker in both runs.
+    digests = {r["digest"] for r in on} | {r["digest"] for r in off}
+    assert len(digests) == 1, (on, off)
+    # Fusion off is the pre-fusion wire protocol: zero fused frames.
+    assert all(r["fused"] == 0 for r in off), off
+    # Fusion on actually fused, covered every partition exactly once,
+    # and cut the wire message count.
+    assert all(r["fused"] > 0 for r in on), on
+    assert (sum(r["push_partitions"] for r in on)
+            == sum(r["push_partitions"] for r in off)), (on, off)
+    assert all(r["push_bytes"] == roff["push_bytes"]
+               for r, roff in zip(on, off)), (on, off)
+    frames_on = sum(r["frames"] for r in on)
+    frames_off = sum(r["frames"] for r in off)
+    assert frames_on < frames_off, (frames_on, frames_off)
+
+
 def test_trace_timeline(tmp_path):
     run_topology(1, 1, WORKER, mode="trace",
                  extra={"BYTEPS_TRACE_ON": "1",
@@ -344,6 +408,18 @@ def test_jax_timeline_combined_capture(tmp_path):
                         "BYTEPS_TRACE_DIR": str(tmp_path / "tr"),
                         "BYTEPS_TRACE_START_STEP": "1",
                         "BYTEPS_TRACE_END_STEP": "3"},
+                 timeout=180)
+
+
+def test_jax_async_seeded_step_updates_not_replaces():
+    """Async seeding regression (ISSUE 2 satellite): the step's delta
+    pushes must land on the SAME wire keys ps_broadcast seeded, so one
+    async SGD step from w=1.0 with grad -4 and lr 0.1 pulls 1.4 — not
+    0.4, which is what the first delta silently *becoming* the
+    parameters produced when the key derivations diverged."""
+    run_topology(1, 1, WORKER, mode="jax_async_seed",
+                 extra={"BYTEPS_PS_MODE": "ps", "BYTEPS_ENABLE_ASYNC": "1",
+                        "BYTEPS_FORCE_DISTRIBUTED": "1"},
                  timeout=180)
 
 
