@@ -156,7 +156,10 @@ class Config:
                 "BYTEPS_FUSION_BYTES must be >= 0 (0 disables small-"
                 "tensor fusion; partitions under the threshold are "
                 "coalesced into multi-key frames)")
-        if self.fusion_keys < 2:
+        if self.fusion_bytes > 0 and self.fusion_keys < 2:
+            # Only meaningful while fusion is on: with BYTEPS_FUSION_BYTES=0
+            # the collector never runs and fusion_keys is ignored, so an
+            # explicitly-disabled config must not fail startup over it.
             raise ValueError(
                 "BYTEPS_FUSION_KEYS must be >= 2 (a fused frame needs at "
                 "least two sub-operations; use BYTEPS_FUSION_BYTES=0 to "

@@ -112,8 +112,11 @@ class KVWorker {
     head.req_id = rid;
     // Striped by key (BYTEPS_VAN_STREAMS): one key's chain stays on one
     // connection, so per-key ordering survives striping. Multi frames
-    // stripe by head.key = their first sub-key; a fused batch rides one
-    // connection, keeping its sub-keys' request/reply order intact.
+    // stripe by head.key = their first sub-key; that is only sound
+    // because the fusion collector batches per (server, stripe fd)
+    // (worker.cc PushLoop), so EVERY sub-key of a fused frame hashes to
+    // the lead key's connection — each key's chain stays on its own
+    // stripe whether it travels fused or as a singleton.
     if (!po_->van().SendV(po_->FdOf(node_id, head.key), head, segs,
                           nsegs)) {
       // Dead connection: the response can never come. Mark the node and

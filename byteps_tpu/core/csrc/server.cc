@@ -262,6 +262,27 @@ void BytePSServer::Process(EngineTask&& task) {
         bool busy = ks->ready[slot] ||
                     (ks->push_count[slot] > 0 && ks->round[slot] != h.version);
         if (busy) {
+          if (task.batch && !task.replied) {
+            // Ack-on-park: record this sub-push's ack into the batch
+            // NOW instead of withholding the frame's CMD_MULTI_ACK
+            // until the slot recycles. The batched ack gates the
+            // worker's fused PULL for every key in the frame, and
+            // pulls are exactly what recycle slots — gating acks on a
+            // parked push lets two workers' frames each withhold the
+            // pull the other's parked push needs, a cross-worker
+            // ack -> slot-recycle -> pull -> ack deadlock cycle.
+            // Backpressure survives: the worker's pull for this round
+            // parks in pending_pulls until the replayed push applies
+            // and the round becomes ready, so the caller's handle
+            // completes no earlier than on the unfused wire.
+            MsgHeader ack{};
+            ack.cmd = CMD_PUSH_ACK;
+            ack.sender = po_->my_id();
+            ack.key = h.key;
+            ack.req_id = h.req_id;
+            task.replied = true;
+            SendReply(task, ack);
+          }
           ks->parked_pushes[slot].push_back(std::move(task));
           break;
         }
@@ -341,7 +362,10 @@ void BytePSServer::Process(EngineTask&& task) {
       ack.key = h.key;
       ack.req_id = h.req_id;
       if (is_async) ack.arg1 = ks->async_pushes;
-      SendReply(task, ack);
+      // A replayed parked sub-push already acked at park time
+      // (ack-on-park above); parking never happens in async mode, so
+      // the skipped ack never carried arg1.
+      if (!task.replied) SendReply(task, ack);
       break;
     }
 

@@ -18,9 +18,14 @@
 // routed to its key's engine thread exactly like a single frame — per-key
 // total ordering and the KeyStore single-writer invariant hold unchanged.
 // The sub-tasks share a MultiReply accumulator; each sub-op's reply (ack
-// or pull response, whenever it fires — parked pushes and pending pulls
-// included) lands in its slot, and the LAST one to settle sends a single
-// batched CMD_MULTI_ACK / CMD_MULTI_PULL_RESP frame back.
+// or pull response) lands in its slot, and the LAST one to settle sends a
+// single batched CMD_MULTI_ACK / CMD_MULTI_PULL_RESP frame back. A
+// sub-push that would PARK records its ack at park time instead of
+// withholding the batch (ack-on-park, see Process): the batched ack gates
+// the worker's fused pull for every key in the frame, and those pulls are
+// what recycle the slot a parked push waits on — gating acks on slot
+// recycling would let two workers' frames deadlock through each other
+// (ack -> slot-recycle -> pull -> ack).
 #pragma once
 
 #include <atomic>
@@ -70,6 +75,10 @@ class BytePSServer {
     int fd = -1;
     std::shared_ptr<MultiReply> batch;
     int sub_idx = -1;
+    // Set when a fused sub-push records its ack at park time
+    // (ack-on-park, see Process CMD_PUSH): the parked replay must not
+    // reply a second time.
+    bool replied = false;
   };
 
   struct KeyStore {

@@ -29,6 +29,13 @@ void BytePSWorker::Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
   kv_ = kv;
   partition_bytes_ = partition_bytes;
   fusion_bytes_ = fusion_bytes < 0 ? 0 : fusion_bytes;
+  // Backstop for direct FFI users (the Python config layer rejects this
+  // combination when fusion is on, and ignores fusion_keys when it is
+  // off): clamp to the minimum batch of 2, loudly when it matters.
+  if (fusion_keys < 2 && fusion_bytes_ > 0) {
+    BPS_LOG(WARNING) << "fusion_keys=" << fusion_keys
+                     << " below the minimum fused batch of 2; clamping to 2";
+  }
   fusion_keys_ = fusion_keys < 2 ? 2 : fusion_keys;
   // Flush linger: how long the collector waits for the enqueuing thread
   // to deliver the next fusible task before flushing a partial batch.
@@ -75,11 +82,19 @@ void BytePSWorker::Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
   // SNDBUF fills, and with ONE push thread a full stripe head-of-line
   // blocks sends to every OTHER stripe/server (exposed by the BDP
   // sweep: N stripes measured one stripe's goodput). Concurrent pops
-  // are order-safe: a key's next-round push cannot be enqueued before
-  // its previous pull completed, so two tasks for the same key never
-  // coexist, and the van's per-fd lock serialises same-connection
-  // writes. Default: match the stripe count (capped), 1 when unstriped
-  // (the single-thread wire order PS_VERBOSE users expect).
+  // are order-safe under the synchronous step pattern every in-tree
+  // caller uses (jax/training.py waits all handles each step): a key's
+  // next-round push_pull is only issued after the previous round's
+  // pull completed, so two tasks for one key never coexist in the
+  // queue, and the van's per-fd lock serialises same-connection
+  // writes. A caller that DEEP-PIPELINES one tensor (3+ push_pull
+  // handles in flight — see the version comment in PushPull) can have
+  // rounds r and r+2 of a key queued at once; per-key wire order then
+  // requires a single push thread (set BYTEPS_PUSH_THREADS=1 when
+  // striping is on), and the fusion collector's duplicate-key flush in
+  // PushLoop handles exactly that case. Default: match the stripe
+  // count (capped), 1 when unstriped (the single-thread wire order
+  // PS_VERBOSE users expect).
   int push_threads = 0;
   if (const char* pt = getenv("BYTEPS_PUSH_THREADS")) {
     push_threads = atoi(pt);
@@ -115,24 +130,37 @@ void BytePSWorker::PushLoop() {
     // session. Fusible tasks keep popping — in priority order, for ANY
     // server (the byte-balanced assignment interleaves servers at the
     // queue head) — and accumulate into one batch per destination
-    // server. A server's batch flushes the moment it reaches the byte
-    // threshold (BYTEPS_FUSION_BYTES) or key cap (BYTEPS_FUSION_KEYS);
-    // the session ends — flushing every partial batch — when a
-    // non-fusible task reaches the queue head or the queue stays empty
-    // past the linger deadline (the enqueuing thread pumps tasks in
-    // slower than this thread drains them; without a short wait every
-    // batch degenerates to a singleton).
-    std::map<int, std::pair<std::vector<PushOp>, int64_t>> acc;
+    // (server, stripe). Batches are keyed by the striped connection fd,
+    // NOT the server alone: a fused frame is routed by its lead key
+    // (SendFusedPush sets h.key = table[0].key), so every key sharing a
+    // frame must hash to the same BYTEPS_VAN_STREAMS connection.
+    // Batching per server would let one key's pushes ride a different
+    // stripe from round to round (fused under a varying lead key, or
+    // singleton under its own stripe), breaking the one-connection-per-
+    // key ordering invariant striping relies on — a later round could
+    // overtake an earlier one on another stripe and wedge the server's
+    // slot. A batch flushes the moment it reaches the byte threshold
+    // (BYTEPS_FUSION_BYTES) or key cap (BYTEPS_FUSION_KEYS); the
+    // session ends — flushing every partial batch — when a non-fusible
+    // task reaches the queue head or the queue stays empty past the
+    // linger deadline (the enqueuing thread pumps tasks in slower than
+    // this thread drains them; without a short wait every batch
+    // degenerates to a singleton).
+    std::map<std::pair<int, int>,
+             std::pair<std::vector<PushOp>, int64_t>> acc;
     const int64_t deadline_us = NowUs() + fusion_linger_us_;
     auto stage = [this, &acc](Task& task) {
-      auto& a = acc[task.server_id];
-      // One operation per key per frame: deep pipelining can enqueue
-      // rounds r and r+2 of one tensor back-to-back, and the server
-      // PARKS an r+2 sub-push until round r's pulls recycle its slot —
-      // pulls this batch would only issue after its own (parked-gated)
-      // ack. Two rounds of one key in one frame is therefore a
-      // self-deadlock; flush the batch and let the next frame carry the
-      // later round, exactly like the unfused wire.
+      const std::pair<int, int> dst{
+          task.server_id, po_->FdOf(task.server_id, task.key)};
+      auto& a = acc[dst];
+      // One operation per key per frame: a deep-pipelining caller
+      // (single push thread — see the thread-count comment in Start)
+      // can enqueue rounds r and r+2 of one tensor back-to-back, and
+      // the server PARKS an r+2 sub-push until round r's pulls recycle
+      // its slot. Two rounds of one key in one frame would also break
+      // the worker-side ack/pull-resp table matching (one slot per
+      // key); flush the batch and let the next frame carry the later
+      // round, exactly like the unfused wire.
       for (const PushOp& prev : a.first) {
         if (prev.p->key == task.key) {
           FlushBatch(task.server_id, std::move(a.first));
@@ -147,7 +175,7 @@ void BytePSWorker::PushLoop() {
       if (a.second >= fusion_bytes_ ||
           static_cast<int>(a.first.size()) >= fusion_keys_) {
         FlushBatch(task.server_id, std::move(a.first));
-        acc.erase(task.server_id);
+        acc.erase(dst);
       }
     };
     stage(t);
@@ -157,7 +185,7 @@ void BytePSWorker::PushLoop() {
       stage(more);
     }
     for (auto& kv : acc) {
-      FlushBatch(kv.first, std::move(kv.second.first));
+      FlushBatch(kv.first.first, std::move(kv.second.first));
     }
   }
 }

@@ -575,6 +575,38 @@ def main() -> int:
             # Hold the fleet until rank 0 finished scraping everyone.
             w.barrier(GROUP_WORKERS)
 
+        elif mode == "fusion_pipeline":
+            # Ack-on-park regression: many small tensors DEEP-PIPELINED —
+            # every round's push_pull for every key issued before any
+            # wait — with fusion on. Rounds r+2/r+3 map onto slots still
+            # serving r/r+1, so fused frames carry sub-pushes the server
+            # must park, and frames MIX rounds (the collector's
+            # duplicate-key flush splits one key's back-to-back rounds
+            # across frames). If a parked sub-push withheld its frame's
+            # batched CMD_MULTI_ACK until its slot recycled, two workers'
+            # frames could each gate the pull the other's parked push
+            # needs (ack -> slot-recycle -> pull -> ack), which this test
+            # would hit as a timeout; the server must instead record a
+            # parked sub-push's ack at park time. Every round's aggregate
+            # must still be exact (integer-valued floats).
+            sizes = [64, 96, 128, 192, 256, 384, 512] * 6  # 42 tensors
+            tids = [w.declare(f"fp{i}", n, "float32", compression="")
+                    for i, n in enumerate(sizes)]
+            scale = sum(r + 1 for r in range(nw))
+            handles = []
+            for rnd in range(4):
+                for i, (tid, n) in enumerate(zip(tids, sizes)):
+                    base = (np.arange(n) % 23 + i + 1).astype(np.float32)
+                    arr = np.ascontiguousarray(
+                        base * (rank + 1) * (rnd + 1))
+                    expect = base * scale * (rnd + 1)
+                    handles.append(
+                        (w.push_pull(tid, arr, average=False), arr,
+                         expect))
+            for h, arr, expect in handles:
+                w.wait(h)
+                np.testing.assert_array_equal(arr, expect)
+
         elif mode == "barrier":
             w.barrier(GROUP_WORKERS)
             print(f"rank {rank} passed barrier")

@@ -82,6 +82,10 @@ def test_fusion_validation():
     with pytest.raises(ValueError, match="BYTEPS_FUSION_LINGER_US"):
         Config(fusion_linger_us=-5).validate()
     Config(fusion_bytes=0).validate()  # 0 = off is legal
+    # fusion_keys is only meaningful while fusion is on: an explicitly
+    # disabled config must not fail startup over it (the C core clamps
+    # the same value with a warning instead of erroring).
+    Config(fusion_bytes=0, fusion_keys=1).validate()
 
 
 def test_invalid_role():

@@ -240,7 +240,7 @@ def test_async_mode():
                  extra={"BYTEPS_ENABLE_ASYNC": "1"})
 
 
-def _run_fusion_topology(fusion_bytes: int):
+def _run_fusion_topology(fusion_bytes: int, streams: int = 0):
     """One 2-worker x 2-server many-small-tensor run; returns the workers'
     result rows (digest + wire counters; parity asserted in-worker)."""
     import json
@@ -267,10 +267,12 @@ def _run_fusion_topology(fusion_bytes: int):
             for s in socks:
                 s.close()
     assert base is not None, "no free port block found"
-    outs = run_topology(2, 2, WORKER, mode="fusion",
-                        extra={"BYTEPS_FUSION_BYTES": str(fusion_bytes),
-                               "BYTEPS_MONITOR_ON": "1",
-                               "BYTEPS_MONITOR_PORT": str(base)})
+    extra = {"BYTEPS_FUSION_BYTES": str(fusion_bytes),
+             "BYTEPS_MONITOR_ON": "1",
+             "BYTEPS_MONITOR_PORT": str(base)}
+    if streams:
+        extra["BYTEPS_VAN_STREAMS"] = str(streams)
+    outs = run_topology(2, 2, WORKER, mode="fusion", extra=extra)
     rows = [json.loads(ln) for o in outs for ln in o.splitlines()
             if ln.startswith("{")]
     assert len(rows) == 2, outs
@@ -302,6 +304,34 @@ def test_fusion_on_off_bit_identical_and_fewer_frames():
     frames_on = sum(r["frames"] for r in on)
     frames_off = sum(r["frames"] for r in off)
     assert frames_on < frames_off, (frames_on, frames_off)
+
+
+def test_fusion_under_striping():
+    """Fusion + BYTEPS_VAN_STREAMS (REVIEW: stripe-routing hazard): the
+    collector batches per (server, stripe), so every key in a fused frame
+    rides the striped connection its own hash picks — a key's chain must
+    never hop stripes depending on batch composition. Fusion must still
+    engage and produce aggregates bit-identical to the unfused wire with
+    the same stripe count."""
+    on = _run_fusion_topology(65536, streams=2)
+    off = _run_fusion_topology(0, streams=2)
+    digests = {r["digest"] for r in on} | {r["digest"] for r in off}
+    assert len(digests) == 1, (on, off)
+    assert all(r["fused"] == 0 for r in off), off
+    assert all(r["fused"] > 0 for r in on), on
+    assert all(r["push_bytes"] == roff["push_bytes"]
+               for r, roff in zip(on, off)), (on, off)
+
+
+def test_fusion_deep_pipeline_parked_acks():
+    """Fused frames whose sub-pushes PARK server-side (REVIEW:
+    batched-ack deadlock): deep-pipelined small tensors force parked
+    sub-pushes inside mixed-round fused frames across two workers; the
+    server must ack a parking sub-push at park time — withholding the
+    batched ack until the slot recycles can deadlock the fleet (this
+    test then times out). Aggregates must stay exact."""
+    run_topology(2, 1, WORKER, mode="fusion_pipeline",
+                 extra={"BYTEPS_FUSION_BYTES": "65536"})
 
 
 def test_trace_timeline(tmp_path):
