@@ -95,6 +95,32 @@ class Config:
     #   monitor.top flags a worker whose mean push latency exceeds
     #   factor x the fleet's low-median (see docs/monitoring.md)
 
+    # --- transient-fault tolerance (ISSUE 3; docs/troubleshooting.md) ------
+    retry_max: int = 4                    # BYTEPS_RETRY_MAX
+    #   max resends per request before the worker declares a persistent
+    #   fault and fail-stops that handle; 0 disables the whole retry/
+    #   reconnect layer (pre-retry fail-fast behavior)
+    retry_timeout_ms: int = 1000          # BYTEPS_RETRY_TIMEOUT_MS
+    #   response timeout before the first resend; doubles per attempt
+    #   (capped at 8x). A server keepalive (duplicate seen, original
+    #   still in progress) resets the attempt budget
+    reconnect_max: int = 3                # BYTEPS_RECONNECT_MAX
+    #   re-dial attempts after a lost worker->server connection before
+    #   escalating to the peer-lost fail-fast path
+    reconnect_backoff_ms: int = 100       # BYTEPS_RECONNECT_BACKOFF_MS
+    #   base backoff between re-dials (doubles per attempt, capped 2 s)
+
+    # --- chaos injection (deterministic fault harness; BYTEPS_CHAOS_*) -----
+    chaos_seed: int = 0                   # BYTEPS_CHAOS_SEED
+    chaos_drop: float = 0.0               # BYTEPS_CHAOS_DROP
+    #   P(drop) per data-plane frame on the send path (0 disables)
+    chaos_dup: float = 0.0                # BYTEPS_CHAOS_DUP
+    #   P(duplicate delivery) per data-plane frame
+    chaos_delay_us: int = 0               # BYTEPS_CHAOS_DELAY_US
+    #   fixed extra latency per data-plane frame
+    chaos_reset_every: int = 0            # BYTEPS_CHAOS_RESET_EVERY
+    #   force a connection reset every N data-plane frames (0 disables)
+
     # --- TPU-specific (new scope; no reference equivalent) -----------------
     ici_axis: str = "ici"                 # mesh axis name for intra-slice
     dcn_axis: str = "dcn"                 # mesh axis name for inter-slice
@@ -181,6 +207,54 @@ class Config:
                 "BYTEPS_STRAGGLER_FACTOR must be >= 1.0 (a worker is "
                 "flagged when its mean push latency exceeds factor x the "
                 "fleet low-median)")
+        if self.retry_max < 0:
+            raise ValueError(
+                "BYTEPS_RETRY_MAX must be >= 0 (0 disables the transient-"
+                "fault retry/reconnect layer)")
+        if self.retry_timeout_ms < 10:
+            raise ValueError(
+                "BYTEPS_RETRY_TIMEOUT_MS must be >= 10 (response timeout "
+                "before the first resend)")
+        if self.reconnect_max < 1:
+            raise ValueError(
+                "BYTEPS_RECONNECT_MAX must be >= 1 (re-dial attempts "
+                "after a lost server connection)")
+        if self.reconnect_backoff_ms < 1:
+            raise ValueError(
+                "BYTEPS_RECONNECT_BACKOFF_MS must be >= 1")
+        if not (0.0 <= self.chaos_drop < 1.0):
+            raise ValueError(
+                "BYTEPS_CHAOS_DROP is a probability in [0, 1): dropping "
+                "every frame can never make progress")
+        if not (0.0 <= self.chaos_dup < 1.0):
+            raise ValueError("BYTEPS_CHAOS_DUP is a probability in [0, 1)")
+        if self.chaos_delay_us < 0:
+            raise ValueError("BYTEPS_CHAOS_DELAY_US must be >= 0")
+        if self.chaos_reset_every < 0:
+            raise ValueError(
+                "BYTEPS_CHAOS_RESET_EVERY must be >= 0 (reset the "
+                "connection every N data frames; 0 disables)")
+        chaos_on = (self.chaos_drop > 0 or self.chaos_dup > 0
+                    or self.chaos_reset_every > 0)
+        if chaos_on and self.retry_max == 0:
+            raise ValueError(
+                "BYTEPS_CHAOS_DROP/_DUP/_RESET_EVERY inject faults that "
+                "only the retry layer can absorb; they require "
+                "BYTEPS_RETRY_MAX > 0 (the combination would just crash "
+                "the fleet at the first injected fault)")
+        if self.heartbeat_interval_s > 0 and \
+                self.heartbeat_timeout_s <= self.heartbeat_interval_s:
+            # A timeout at-or-below the interval declares healthy nodes
+            # dead on the first missed tick: the scheduler checks ages
+            # every interval, and a node's age legitimately reaches the
+            # full interval between beats. Fail fast with the fix named.
+            raise ValueError(
+                f"PS_HEARTBEAT_TIMEOUT ({self.heartbeat_timeout_s}s) must "
+                f"be greater than PS_HEARTBEAT_INTERVAL "
+                f"({self.heartbeat_interval_s}s) — a timeout at or below "
+                "the interval declares healthy nodes dead on their first "
+                "missed tick; use a timeout of several intervals (default "
+                "5s/30s)")
         return self
 
 
@@ -217,6 +291,15 @@ def load_config() -> Config:
         monitor_port=_env_int("BYTEPS_MONITOR_PORT", 9100),
         straggler_factor=float(
             os.environ.get("BYTEPS_STRAGGLER_FACTOR", "2.0")),
+        retry_max=_env_int("BYTEPS_RETRY_MAX", 4),
+        retry_timeout_ms=_env_int("BYTEPS_RETRY_TIMEOUT_MS", 1000),
+        reconnect_max=_env_int("BYTEPS_RECONNECT_MAX", 3),
+        reconnect_backoff_ms=_env_int("BYTEPS_RECONNECT_BACKOFF_MS", 100),
+        chaos_seed=_env_int("BYTEPS_CHAOS_SEED", 0),
+        chaos_drop=float(os.environ.get("BYTEPS_CHAOS_DROP", "0") or 0),
+        chaos_dup=float(os.environ.get("BYTEPS_CHAOS_DUP", "0") or 0),
+        chaos_delay_us=_env_int("BYTEPS_CHAOS_DELAY_US", 0),
+        chaos_reset_every=_env_int("BYTEPS_CHAOS_RESET_EVERY", 0),
         ici_axis=_env_str("BYTEPS_ICI_AXIS", "ici"),
         dcn_axis=_env_str("BYTEPS_DCN_AXIS", "dcn"),
         ps_mode=_env_str("BYTEPS_PS_MODE", "auto").lower(),

@@ -150,6 +150,12 @@ int bps_init(int role) {
                                     std::to_string(node_id) +
                                     " lost (peer died or was killed)");
     });
+    // Transient path: a reset server connection that re-dialled
+    // successfully drains this node's resend queue over the fresh
+    // socket immediately (ISSUE 3 reconnect-with-backoff).
+    gl->po->SetPeerReconnectedCallback([gl](int node_id) {
+      gl->kv->ResendNode(node_id);
+    });
   }
 
   int id = gl->po->Start(gl->role, uri, port, nw, ns, std::move(handler));
@@ -178,6 +184,16 @@ void bps_finalize() {
   gl->po->Finalize();
   if (gl->server) gl->server->Stop();
   gl->inited = false;
+}
+
+// 1 when this node saw a FAILURE shutdown (scheduler dead-node
+// broadcast, arg0=1, or a lost scheduler connection) rather than the
+// clean all-goodbyes teardown. Valid after finalize — server/scheduler
+// entry points use it to exit nonzero so supervisors can tell crash
+// from completion.
+int bps_failure_shutdown() {
+  Global* gl = g();
+  return gl->po && gl->po->FailureShutdown() ? 1 : 0;
 }
 
 int bps_my_id() { return g()->po->my_id(); }

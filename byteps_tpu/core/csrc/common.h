@@ -81,7 +81,32 @@ enum Command : int32_t {
   CMD_MULTI_ACK = 18,        // server -> worker: batched push acks
   CMD_MULTI_PULL = 19,       // worker -> server: batched CMD_PULL ops
   CMD_MULTI_PULL_RESP = 20,  // server -> worker: batched pull responses
+  CMD_KEEPALIVE = 21,        // server -> worker: "your duplicate request
+                             // is known and still being worked on" — the
+                             // retry layer resets the request's attempt
+                             // budget instead of escalating to fail-stop
+                             // (a parked pull can legitimately wait out
+                             // many retry timeouts behind a slow peer).
 };
+
+// Transient-fault tolerance: commands eligible for chaos injection,
+// idempotent retry, and server-side dedup. Control-plane traffic
+// (register/addrbook/barrier/heartbeat/shutdown) is NEVER injected or
+// retried — dropping a heartbeat would fake a node death, and the
+// topology handshake has its own retry (Van::Connect).
+inline bool IsDataPlaneCmd(int32_t cmd) {
+  switch (cmd) {
+    case CMD_PUSH: case CMD_PUSH_ACK: case CMD_PULL: case CMD_PULL_RESP:
+    case CMD_INIT_KEY: case CMD_INIT_ACK:
+    case CMD_BCAST_PUSH: case CMD_BCAST_PULL:
+    case CMD_MULTI_PUSH: case CMD_MULTI_ACK:
+    case CMD_MULTI_PULL: case CMD_MULTI_PULL_RESP:
+    case CMD_KEEPALIVE:
+      return true;
+    default:
+      return false;
+  }
+}
 
 // --- message flags ----------------------------------------------------------
 
@@ -108,6 +133,15 @@ struct MsgHeader {
   int64_t arg0 = 0;        // cmd-specific (e.g. decompressed len for PUSH,
                            // listen port for REGISTER, count for BARRIER)
   int64_t arg1 = 0;        // cmd-specific (e.g. role for REGISTER)
+  int64_t seq = 0;         // per-connection monotone frame sequence,
+                           // stamped by the van under the per-fd send
+                           // lock. A receiver-side gap (seq jumps) means
+                           // frames were lost on this connection (chaos
+                           // drop, or a reset mid-stream); a repeat means
+                           // duplicate delivery. Pure observability
+                           // (bps_seq_gaps_total / bps_seq_dups_total);
+                           // end-to-end retry dedup keys on (sender,
+                           // req_id), which is worker-monotone.
 };
 #pragma pack(pop)
 

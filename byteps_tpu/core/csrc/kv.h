@@ -21,6 +21,7 @@
 
 #include "common.h"
 #include "logging.h"
+#include "metrics.h"
 #include "postoffice.h"
 
 namespace bps {
@@ -44,12 +45,35 @@ class KVWorker {
     for (size_t i = 0; i < exec_queues_.size(); ++i) {
       exec_threads_.emplace_back([this, i] { ExecLoop(i); });
     }
+    // Idempotent-retry layer (ISSUE 3 transient-fault tolerance): every
+    // request keeps its header + payload segment list until it settles;
+    // a timer thread resends requests whose response is overdue with
+    // capped exponential backoff. The server dedups replays by (sender,
+    // req_id) — ack-without-reapply — so a resend is always safe.
+    // BYTEPS_RETRY_MAX=0 disables the layer entirely (no snapshot
+    // bookkeeping, no timer thread: the pre-retry hot path).
+    if (const char* v = getenv("BYTEPS_RETRY_MAX")) retry_max_ = atoi(v);
+    if (const char* v = getenv("BYTEPS_RETRY_TIMEOUT_MS")) {
+      retry_timeout_ms_ = atol(v);
+      if (retry_timeout_ms_ < 10) retry_timeout_ms_ = 10;
+    }
+    if (retry_max_ > 0) {
+      Metrics::Get().Counter("bps_retries_total");
+      retry_thread_ = std::thread([this] { RetryLoop(); });
+    }
   }
 
   ~KVWorker() { StopExec(); }
 
-  // Drain queued callbacks, then stop the executor threads. Idempotent.
+  // Drain queued callbacks, then stop the executor + retry threads.
+  // Idempotent.
   void StopExec() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      retry_stop_ = true;
+    }
+    cv_.notify_all();
+    if (retry_thread_.joinable()) retry_thread_.join();
     for (auto& q : exec_queues_) {
       std::lock_guard<std::mutex> lk(q->mu);
       q->stop = true;
@@ -64,15 +88,21 @@ class KVWorker {
   // Issue a request to `node_id`; `cb` fires on an executor thread when
   // the matching response (same req_id) arrives, or with a synthetic
   // CMD_ERROR message if the peer's connection is already/later found
-  // dead. Returns the req id, or -1 if the send failed outright (the
-  // callback then fires with CMD_ERROR before Request returns).
+  // dead. Returns the req id, or -1 if the send failed outright with the
+  // retry layer off (the callback then fires with CMD_ERROR before
+  // Request returns). `hold` optionally pins transient payload storage
+  // (e.g. a fused frame's sub-header table) for the request's lifetime;
+  // all other payload segments must stay valid until `cb` fires — the
+  // contract every call site already honours for the zero-copy send, and
+  // what makes resends copy-free.
   int Request(int node_id, MsgHeader head, const void* payload,
-              int64_t payload_len, Callback cb) {
+              int64_t payload_len, Callback cb,
+              std::shared_ptr<void> hold = nullptr) {
     struct iovec one;
     one.iov_base = const_cast<void*>(payload);
     one.iov_len = static_cast<size_t>(payload_len > 0 ? payload_len : 0);
     return RequestV(node_id, head, &one, payload_len > 0 ? 1 : 0,
-                    std::move(cb));
+                    std::move(cb), std::move(hold));
   }
 
   // Gather variant (fusion layer): the request payload is the
@@ -81,12 +111,16 @@ class KVWorker {
   // answers a CMD_MULTI_* batch with a single batched reply, so `cb`
   // fires once for the entire sub-operation set.
   int RequestV(int node_id, MsgHeader head, const struct iovec* segs,
-               int nsegs, Callback cb) {
+               int nsegs, Callback cb,
+               std::shared_ptr<void> hold = nullptr) {
     int rid;
     bool dead;
+    const bool retry_on = retry_max_ > 0;
+    head.sender = po_->my_id();
     {
       std::lock_guard<std::mutex> lk(mu_);
       rid = next_req_id_++;
+      head.req_id = rid;
       // A peer already known dead: without this check a chained request
       // issued during the peer-lost window could still write() into the
       // half-closed socket "successfully" and then sit in pending_
@@ -94,7 +128,19 @@ class KVWorker {
       // mark and the FailNode pending-scan share mu_, so every request
       // either lands in pending_ before the scan or sees the mark here.
       dead = dead_nodes_.count(node_id) > 0;
-      if (!dead) pending_[rid] = PendingReq{std::move(cb), node_id};
+      if (!dead) {
+        PendingReq pr;
+        pr.cb = std::move(cb);
+        pr.node = node_id;
+        if (retry_on) {
+          // Resend snapshot: header + the caller-stable segment list.
+          pr.head = head;
+          pr.segs.assign(segs, segs + nsegs);
+          pr.hold = std::move(hold);
+          pr.deadline_ms = NowMs() + retry_timeout_ms_;
+        }
+        pending_[rid] = std::move(pr);
+      }
     }
     if (dead) {
       if (cb) {
@@ -108,8 +154,6 @@ class KVWorker {
       }
       return -1;
     }
-    head.sender = po_->my_id();
-    head.req_id = rid;
     // Striped by key (BYTEPS_VAN_STREAMS): one key's chain stays on one
     // connection, so per-key ordering survives striping. Multi frames
     // stripe by head.key = their first sub-key; that is only sound
@@ -119,10 +163,18 @@ class KVWorker {
     // stripe whether it travels fused or as a singleton.
     if (!po_->van().SendV(po_->FdOf(node_id, head.key), head, segs,
                           nsegs)) {
-      // Dead connection: the response can never come. Mark the node and
-      // fail THIS request immediately (VERDICT r2 weak #7 — a push into
-      // a dead connection used to block its handle until the heartbeat
-      // detector fired).
+      if (retry_on) {
+        // Transient stance: the frame is lost but the request stays
+        // pending — the van's disconnect handler is already driving a
+        // reconnect (the failed send and the recv-side EOF have the
+        // same cause), after which ResendNode or the retry timer
+        // re-issues it. Only exhausted reconnects/retries escalate.
+        return rid;
+      }
+      // Retry layer off: dead connection means the response can never
+      // come. Mark the node and fail THIS request immediately (VERDICT
+      // r2 weak #7 — a push into a dead connection used to block its
+      // handle until the heartbeat detector fired).
       {
         std::lock_guard<std::mutex> lk(mu_);
         dead_nodes_.insert(node_id);
@@ -157,6 +209,19 @@ class KVWorker {
   // Runs on the van receive thread: must not block and must not send —
   // just settle the request table and hand the callback to the executor.
   void OnResponse(Message&& msg) {
+    if (msg.head.cmd == CMD_KEEPALIVE) {
+      // The server saw our duplicate and is still working on the
+      // original (e.g. a pull parked behind a slow peer's push): reset
+      // the attempt budget so a legitimately slow round never exhausts
+      // retries — only true silence escalates to fail-stop.
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = pending_.find(msg.head.req_id);
+      if (it != pending_.end() && retry_max_ > 0) {
+        it->second.attempts = 0;
+        it->second.deadline_ms = NowMs() + retry_timeout_ms_;
+      }
+      return;
+    }
     Callback cb;
     {
       std::lock_guard<std::mutex> lk(mu_);
@@ -212,11 +277,135 @@ class KVWorker {
     }
   }
 
+  // Immediately re-issue every in-flight request addressed to `node_id`
+  // over its (freshly reconnected) connection, instead of waiting out
+  // each request's retry timeout. Invoked from the postoffice's
+  // peer-reconnected callback on a van thread.
+  void ResendNode(int node_id) {
+    if (retry_max_ <= 0) return;
+    std::vector<Resend> work;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (auto& kv : pending_) {
+        if (kv.second.node != node_id) continue;
+        work.push_back(SnapshotForResend(kv.first, kv.second));
+        kv.second.deadline_ms = NowMs() + retry_timeout_ms_;
+      }
+    }
+    if (!work.empty()) {
+      BPS_LOG(WARNING) << "resending " << work.size()
+                       << " in-flight request(s) to reconnected node "
+                       << node_id;
+    }
+    DoResends(work);
+  }
+
  private:
   struct PendingReq {
     Callback cb;
     int node = -1;
+    // Retry snapshot (retry layer on): the header exactly as first
+    // sent, the caller-stable payload segments, and an optional
+    // lifetime pin for transient storage (fused-frame tables).
+    MsgHeader head{};
+    std::vector<struct iovec> segs;
+    std::shared_ptr<void> hold;
+    int64_t deadline_ms = 0;
+    int attempts = 0;
   };
+
+  struct Resend {
+    int rid;
+    int node;
+    MsgHeader head;
+    std::string payload;  // owned flat copy of the request payload
+  };
+
+  // Flatten a pending request's payload into an OWNED copy, under mu_.
+  // Must be called while the entry is alive: an unsettled request's
+  // segments are guaranteed valid (the callback has not fired, so the
+  // caller has not reclaimed its buffers, and `hold` pins any transient
+  // table). The copy is what makes the actual send safe to run OUTSIDE
+  // mu_ — without it, a request settling between snapshot and send
+  // frees the buffers under the resend and ships a garbage frame.
+  Resend SnapshotForResend(int rid, const PendingReq& pr) {
+    Resend r;
+    r.rid = rid;
+    r.node = pr.node;
+    r.head = pr.head;
+    size_t total = 0;
+    for (const auto& s : pr.segs) total += s.iov_len;
+    r.payload.reserve(total);
+    for (const auto& s : pr.segs) {
+      r.payload.append(static_cast<const char*>(s.iov_base), s.iov_len);
+    }
+    return r;
+  }
+
+  // Re-issue the given snapshots (outside mu_ — sends can block). A
+  // resend that races its response is harmless: the server's dedup
+  // window acks-without-reapplying, and OnResponse drops the duplicate
+  // reply. A failed resend is NOT counted as an attempt — the
+  // reconnect/peer-lost machinery owns escalation for dead connections;
+  // attempts only measure delivered-but-unanswered sends.
+  void DoResends(const std::vector<Resend>& work) {
+    for (const auto& r : work) {
+      struct iovec one;
+      one.iov_base = const_cast<char*>(r.payload.data());
+      one.iov_len = r.payload.size();
+      bool ok = po_->van().SendV(po_->FdOf(r.node, r.head.key), r.head,
+                                 &one, r.payload.empty() ? 0 : 1);
+      if (!ok) continue;
+      BPS_METRIC_COUNTER_ADD("bps_retries_total", 1);
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = pending_.find(r.rid);
+      if (it == pending_.end()) continue;  // settled while resending
+      ++it->second.attempts;
+    }
+  }
+
+  // Timer thread: resend overdue requests with capped exponential
+  // backoff; escalate to CMD_ERROR only when a request has been resent
+  // BYTEPS_RETRY_MAX times with neither a response nor a server
+  // keepalive — the in-band signal is then that the server is not
+  // processing us at all (its van would dedup-and-keepalive a live but
+  // slow request), which is exactly the persistent fault that should
+  // fail-stop.
+  void RetryLoop() {
+    const int64_t tick_ms =
+        retry_timeout_ms_ / 4 > 20 ? retry_timeout_ms_ / 4 : 20;
+    for (;;) {
+      std::vector<Resend> work;
+      std::vector<int> exhausted;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait_for(lk, std::chrono::milliseconds(tick_ms),
+                     [this] { return retry_stop_; });
+        if (retry_stop_) return;
+        int64_t now = NowMs();
+        for (auto& kv : pending_) {
+          PendingReq& pr = kv.second;
+          if (pr.deadline_ms <= 0 || now < pr.deadline_ms) continue;
+          if (pr.attempts >= retry_max_) {
+            exhausted.push_back(kv.first);
+            continue;
+          }
+          // Next deadline: base doubled per attempt, capped at 8x.
+          int shift = pr.attempts < 3 ? pr.attempts + 1 : 3;
+          pr.deadline_ms = now + (retry_timeout_ms_ << shift);
+          work.push_back(SnapshotForResend(kv.first, pr));
+        }
+      }
+      DoResends(work);
+      if (!exhausted.empty()) {
+        FailRequests(exhausted,
+                     "request unanswered after " +
+                         std::to_string(retry_max_) +
+                         " retries (no response, no keepalive) — "
+                         "persistent fault, failing fast");
+      }
+    }
+  }
 
   // Settle `rids` as failed: each callback fires (on the caller's thread)
   // with a synthetic CMD_ERROR message carrying the diagnostic.
@@ -272,6 +461,11 @@ class KVWorker {
   int64_t done_count_ = 0;
   std::vector<std::unique_ptr<ExecQueue>> exec_queues_;
   std::vector<std::thread> exec_threads_;
+  // Retry layer (BYTEPS_RETRY_MAX / BYTEPS_RETRY_TIMEOUT_MS).
+  int retry_max_ = 4;
+  int64_t retry_timeout_ms_ = 1000;
+  bool retry_stop_ = false;  // guarded by mu_
+  std::thread retry_thread_;
 };
 
 }  // namespace bps

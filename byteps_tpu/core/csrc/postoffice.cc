@@ -7,6 +7,7 @@
 #include <cstring>
 
 #include "logging.h"
+#include "metrics.h"
 
 namespace bps {
 
@@ -21,6 +22,19 @@ static double EnvSeconds(const char* name, double dflt) {
   return v && *v ? atof(v) : dflt;
 }
 
+static long EnvLong(const char* name, long dflt) {
+  const char* v = getenv(name);
+  return v && *v ? atol(v) : dflt;
+}
+
+// Transient-fault tolerance master switch: BYTEPS_RETRY_MAX > 0 (default
+// on). 0 restores the pre-retry fail-fast behavior everywhere — any lost
+// connection immediately fails that peer's in-flight requests.
+bool RetryEnabled() {
+  static const bool on = EnvLong("BYTEPS_RETRY_MAX", 4) > 0;
+  return on;
+}
+
 int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
                       int num_workers, int num_servers,
                       AppHandler app_handler) {
@@ -31,25 +45,45 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
   van_ = std::make_unique<Van>(
       [this](Message&& m, int fd) { ControlHandler(std::move(m), fd); });
   van_->SetDisconnectHandler([this](int fd) {
-    if (shutting_down_.load() || !peer_lost_cb_) return;
+    if (shutting_down_.load()) return;
     int node_id = -1;
+    int stripe = -1;
     {
       std::lock_guard<std::mutex> lk(mu_);
       for (const auto& kv : node_fd_) {
-        if (kv.second == fd) { node_id = kv.first; break; }
+        if (kv.second == fd) { node_id = kv.first; stripe = 0; break; }
       }
       if (node_id < 0) {
-        // A lost STRIPE also means the peer is gone (one process owns
+        // A lost STRIPE maps back to its peer too (one process owns
         // every stripe of a connection pair).
         for (const auto& kv : node_extra_fds_) {
-          for (int efd : kv.second) {
-            if (efd == fd) { node_id = kv.first; break; }
+          for (size_t s = 0; s < kv.second.size(); ++s) {
+            if (kv.second[s] == fd) {
+              node_id = kv.first;
+              stripe = static_cast<int>(s) + 1;
+              break;
+            }
           }
           if (node_id >= 0) break;
         }
       }
     }
-    if (node_id >= 0) peer_lost_cb_(node_id);
+    if (node_id < 0) return;
+    // Transient-vs-persistent fork (SURVEY.md §5, ISSUE 3): a worker's
+    // lost server connection is first treated as TRANSIENT — re-dial
+    // with capped backoff and let the KV retry layer drain its resend
+    // queue over the fresh connection. Only when the re-dial exhausts
+    // its attempts (peer process actually gone) does it escalate to
+    // the pre-existing fail-fast path. Scheduler connections are never
+    // reconnected: heartbeat state lives there, and losing it already
+    // has its own failure-shutdown handling (HeartbeatLoop).
+    if (role_ == ROLE_WORKER && node_id != kSchedulerId &&
+        RetryEnabled() && TryReconnect(node_id, stripe)) {
+      BPS_METRIC_COUNTER_ADD("bps_reconnects_total", 1);
+      if (peer_reconnected_cb_) peer_reconnected_cb_(node_id);
+      return;
+    }
+    if (peer_lost_cb_) peer_lost_cb_(node_id);
   });
 
   // Fleet-formation bound: until the topology completes no job can be
@@ -327,6 +361,10 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
         }
       } else {
         BPS_LOG(DEBUG) << "node " << my_id_ << ": received fleet SHUTDOWN";
+        // arg0 == 1 marks a FAILURE shutdown (dead-node broadcast from
+        // the scheduler's heartbeat monitor) vs the clean teardown;
+        // server entry points exit nonzero on it.
+        if (msg.head.arg0 == 1) failure_shutdown_.store(true);
         shutting_down_.store(true);
         {
           std::lock_guard<std::mutex> lk(mu_);
@@ -386,6 +424,64 @@ int Postoffice::FdOf(int node_id, int64_t key) {
   return s == 0 ? it->second : ex->second[s - 1];
 }
 
+bool Postoffice::TryReconnect(int node_id, int stripe) {
+  NodeInfo target{};
+  bool found = false;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (const auto& n : nodes_) {
+      if (n.id == node_id) { target = n; found = true; break; }
+    }
+  }
+  if (!found) return false;
+  const int max_attempts =
+      static_cast<int>(EnvLong("BYTEPS_RECONNECT_MAX", 3));
+  long backoff_ms = EnvLong("BYTEPS_RECONNECT_BACKOFF_MS", 100);
+  if (backoff_ms < 1) backoff_ms = 1;
+  for (int attempt = 0; attempt < max_attempts; ++attempt) {
+    if (attempt > 0) {
+      // Capped exponential backoff between re-dials: a restarting peer
+      // gets breathing room, a dead one costs at most the full ladder.
+      long wait = backoff_ms << std::min(attempt - 1, 6);
+      if (wait > 2000) wait = 2000;
+      for (long slept = 0; slept < wait && !shutting_down_.load();
+           slept += 50) {
+        usleep(50 * 1000);
+      }
+    }
+    if (shutting_down_.load() || van_->stopped()) return false;
+    int fd = van_->Connect(target.host, target.port, 1);
+    if (fd < 0) continue;
+    // Re-identify on the fresh connection, exactly like the original
+    // stripe dial: the server records/keeps the worker's primary fd and
+    // answers requests on whichever fd they arrive on.
+    MsgHeader hello{};
+    hello.cmd = CMD_REGISTER;
+    hello.sender = my_id_;
+    hello.arg1 = role_;
+    if (!van_->Send(fd, hello)) continue;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (stripe == 0) {
+        node_fd_[node_id] = fd;
+      } else {
+        auto& extra = node_extra_fds_[node_id];
+        if (static_cast<size_t>(stripe - 1) < extra.size()) {
+          extra[static_cast<size_t>(stripe - 1)] = fd;
+        }
+      }
+    }
+    BPS_LOG(WARNING) << "node " << my_id_ << ": reconnected to node "
+                     << node_id << " (stripe " << stripe << ", attempt "
+                     << attempt + 1 << ") — resuming in-flight requests";
+    return true;
+  }
+  BPS_LOG(WARNING) << "node " << my_id_ << ": reconnect to node "
+                   << node_id << " failed after " << max_attempts
+                   << " attempt(s) — treating peer as dead";
+  return false;
+}
+
 void Postoffice::HeartbeatLoop() {
   double interval = EnvSeconds("PS_HEARTBEAT_INTERVAL", 5.0);
   while (!shutting_down_.load() && !van_->stopped()) {
@@ -408,6 +504,7 @@ void Postoffice::HeartbeatLoop() {
       if (!shutting_down_.load()) {
         BPS_LOG(WARNING) << "node " << my_id_
                          << ": scheduler connection lost — failure shutdown";
+        failure_shutdown_.store(true);
         shutting_down_.store(true);
         {
           std::lock_guard<std::mutex> lk(mu_);

@@ -61,9 +61,27 @@ class Postoffice {
   // Invoked (on a van thread) when the connection to a known peer node
   // drops while the fleet is running — the fast-fail signal for that
   // node's in-flight requests (heartbeat timeout is the slow fallback).
+  // With the retry layer on (BYTEPS_RETRY_MAX > 0) this only fires after
+  // reconnect-with-backoff exhausted its attempts: a transient reset is
+  // absorbed in-band, only a persistent fault escalates.
   void SetPeerLostCallback(std::function<void(int node_id)> cb) {
     peer_lost_cb_ = std::move(cb);
   }
+
+  // Invoked (on a van thread) after a lost worker->server connection was
+  // re-established (transient fault absorbed): the KV layer resends that
+  // node's in-flight requests over the fresh connection immediately
+  // instead of waiting out their retry timeouts.
+  void SetPeerReconnectedCallback(std::function<void(int node_id)> cb) {
+    peer_reconnected_cb_ = std::move(cb);
+  }
+
+  // True once this node received (or itself triggered) a FAILURE
+  // shutdown — the scheduler's dead-node broadcast (CMD_SHUTDOWN
+  // arg0=1) or a lost scheduler connection — as opposed to the clean
+  // all-workers-said-goodbye teardown. Server/scheduler entry points
+  // exit nonzero on it so a supervisor can tell crash from completion.
+  bool FailureShutdown() const { return failure_shutdown_.load(); }
 
   // --- topology queries ---
   int my_id() const { return my_id_; }
@@ -94,6 +112,13 @@ class Postoffice {
  private:
   void ControlHandler(Message&& msg, int fd);
   void HeartbeatLoop();
+  // Re-dial a lost worker->server connection (stripe `stripe`; 0 =
+  // primary) with capped exponential backoff (BYTEPS_RECONNECT_MAX /
+  // BYTEPS_RECONNECT_BACKOFF_MS). On success the fresh fd replaces the
+  // dead one in node_fd_/node_extra_fds_ and the worker re-identifies
+  // itself (CMD_REGISTER hello, as at stripe dial time). Runs on the
+  // dead connection's recv thread, before its CloseConn.
+  bool TryReconnect(int node_id, int stripe);
 
   std::unique_ptr<Van> van_;
   AppHandler app_handler_;
@@ -102,6 +127,7 @@ class Postoffice {
   int num_workers_ = 0;
   int num_servers_ = 0;
   std::atomic<bool> shutting_down_{false};
+  std::atomic<bool> failure_shutdown_{false};
 
   std::mutex mu_;
   std::condition_variable cv_;
@@ -128,8 +154,14 @@ class Postoffice {
   std::thread monitor_thread_;  // scheduler: dead-node detection
   std::function<void()> shutdown_cb_;
   std::function<void(int)> peer_lost_cb_;
+  std::function<void(int)> peer_reconnected_cb_;
 };
 
 int64_t NowMs();
+
+// BYTEPS_RETRY_MAX > 0 (default 4): the transient-fault tolerance master
+// switch shared by the van reconnect path (postoffice.cc) and the KV
+// retry layer (kv.h). 0 = pre-retry fail-fast behavior.
+bool RetryEnabled();
 
 }  // namespace bps

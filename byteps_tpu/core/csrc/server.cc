@@ -206,10 +206,139 @@ BytePSServer::KeyStore* BytePSServer::GetStore(int64_t key) {
   return it == store_.end() ? nullptr : it->second.get();
 }
 
+void BytePSServer::MarkReplied(KeyStore* ks, int32_t sender,
+                               int32_t req_id,
+                               const MsgHeader& reply_head) {
+  if (!RetryEnabled()) return;
+  auto it = ks->seen.find(sender);
+  if (it != ks->seen.end() && it->second.req_id == req_id) {
+    it->second.replied = true;
+    it->second.reply_head = reply_head;
+  }
+}
+
+void BytePSServer::SendKeepalive(const EngineTask& t) {
+  MsgHeader ka{};
+  ka.cmd = CMD_KEEPALIVE;
+  ka.sender = po_->my_id();
+  ka.key = t.msg.head.key;
+  ka.req_id = t.msg.head.req_id;
+  // Direct van frame, NOT SendReply: a keepalive is per-request flow
+  // control, not a reply slot — for a duplicated fused frame each
+  // still-parked sub sends its own keepalive (same req_id; the worker
+  // resets the frame's budget once per arrival) while the ORIGINAL
+  // frame's MultiReply still owns the real batched reply. The
+  // duplicate's MultiReply then never flushes; it is a small, bounded
+  // leak (one per duplicate of a partially-parked frame) that dies
+  // with the batch shared_ptr.
+  po_->van().Send(t.fd, ka);
+}
+
+void BytePSServer::SendWireError(int fd, const MsgHeader& req,
+                                 const std::string& why) {
+  MsgHeader err{};
+  err.cmd = CMD_ERROR;
+  err.sender = po_->my_id();
+  err.key = req.key;
+  err.req_id = req.req_id;
+  BPS_LOG(WARNING) << "server: failing req " << req.req_id << " (key "
+                   << req.key << "): " << why;
+  po_->van().Send(fd, err, why.data(), static_cast<int64_t>(why.size()));
+}
+
+// A (sender, req_id) match in the dedup window: the frame is a wire
+// duplicate — a chaos dup, or a retry of a request whose reply was
+// lost. Answer from recorded state; NEVER re-apply (a re-summed push or
+// a double-counted pull_count would corrupt the round).
+void BytePSServer::AnswerDuplicate(KeyStore* ks, KeyStore::SenderRec& rec,
+                                   EngineTask& task) {
+  const MsgHeader& h = task.msg.head;
+  if (!rec.replied) {
+    // Original still in flight (parked push/pull, or a round waiting on
+    // peers): tell the worker we have it so its retry budget resets.
+    SendKeepalive(task);
+    return;
+  }
+  MsgHeader head = rec.reply_head;
+  switch (head.cmd) {
+    case CMD_PUSH_ACK:
+      SendReply(task, head);
+      return;
+    case CMD_PULL_RESP: {
+      if (h.cmd == CMD_BCAST_PULL) {
+        auto it = ks->bcast_rounds.find(h.version);
+        if (it != ks->bcast_rounds.end()) {
+          SendReply(task, head, it->second.data.data(),
+                    static_cast<int64_t>(it->second.data.size()));
+        } else if (h.version == ks->last_bcast_round && ks->param_init) {
+          SendReply(task, head, ks->param.data(),
+                    static_cast<int64_t>(ks->param.size()));
+        } else {
+          SendWireError(task.fd, h,
+                        "bcast round " + std::to_string(h.version) +
+                            " no longer held for replay");
+        }
+        return;
+      }
+      if (async_ || (h.flags & FLAG_ASYNC)) {
+        // Async reads are idempotent; re-serve the live value.
+        SendReply(task, head, ks->param.data(),
+                  static_cast<int64_t>(ks->param.size()));
+        return;
+      }
+      int slot = h.version & 1;
+      if (ks->round[slot] == h.version || ks->last_round[slot] == h.version) {
+        if (head.flags & FLAG_COMPRESSED) {
+          SendReply(task, head, ks->comp_reply[slot].data(),
+                    static_cast<int64_t>(ks->comp_reply[slot].size()));
+        } else {
+          SendReply(task, head, ks->slot[slot].data(),
+                    static_cast<int64_t>(ks->slot[slot].size()));
+        }
+        return;
+      }
+      // Replay window outrun: the slot was reassigned before this
+      // worker's reply was delivered — only reachable when a caller
+      // deep-pipelines 3+ rounds of one tensor through lossy chaos.
+      // Serving the new round's bytes would be silent corruption; the
+      // honest move is today's fail-stop, scoped to this handle.
+      SendWireError(task.fd, h,
+                    "round " + std::to_string(h.version) + " for key " +
+                        std::to_string(h.key) +
+                        " was recycled before its reply was delivered "
+                        "(deep pipelining + loss); cannot replay");
+      return;
+    }
+    default:
+      SendWireError(task.fd, h, "unexpected recorded reply cmd " +
+                                    std::to_string(head.cmd));
+  }
+}
+
 void BytePSServer::Process(EngineTask&& task) {
   Message& msg = task.msg;
   const MsgHeader& h = msg.head;
   const int fd = task.fd;
+  // Dedup window (see KeyStore::SenderRec): applies to the per-key
+  // stateful commands. INIT_KEY is naturally idempotent and skips it.
+  if (RetryEnabled() && !task.from_park &&
+      (h.cmd == CMD_PUSH || h.cmd == CMD_PULL || h.cmd == CMD_BCAST_PUSH ||
+       h.cmd == CMD_BCAST_PULL)) {
+    KeyStore* ks = GetStore(h.key);
+    if (ks) {
+      auto& rec = ks->seen[h.sender];
+      if (rec.req_id == h.req_id) {
+        AnswerDuplicate(ks, rec, task);
+        return;
+      }
+      // New request from this sender: open its window entry. The reply
+      // sites below mark it replied (ack-on-park acks immediately;
+      // parked singles/pulls stay unreplied until their replay).
+      rec.req_id = h.req_id;
+      rec.replied = false;
+      rec.reply_head = MsgHeader{};
+    }
+  }
   switch (h.cmd) {
     case CMD_INIT_KEY: {
       {
@@ -281,6 +410,7 @@ void BytePSServer::Process(EngineTask&& task) {
             ack.key = h.key;
             ack.req_id = h.req_id;
             task.replied = true;
+            MarkReplied(ks, h.sender, h.req_id, ack);
             SendReply(task, ack);
           }
           ks->parked_pushes[slot].push_back(std::move(task));
@@ -365,7 +495,10 @@ void BytePSServer::Process(EngineTask&& task) {
       // A replayed parked sub-push already acked at park time
       // (ack-on-park above); parking never happens in async mode, so
       // the skipped ack never carried arg1.
-      if (!task.replied) SendReply(task, ack);
+      if (!task.replied) {
+        MarkReplied(ks, h.sender, h.req_id, ack);
+        SendReply(task, ack);
+      }
       break;
     }
 
@@ -383,6 +516,7 @@ void BytePSServer::Process(EngineTask&& task) {
         BPS_CHECK(ks->param_init) << "async pull before any push " << h.key;
         BPS_METRIC_COUNTER_ADD("bps_server_reply_bytes_total",
                                static_cast<int64_t>(ks->param.size()));
+        MarkReplied(ks, h.sender, h.req_id, resp);
         SendReply(task, resp, ks->param.data(), ks->param.size());
       } else {
         int slot = h.version & 1;
@@ -402,6 +536,7 @@ void BytePSServer::Process(EngineTask&& task) {
       // async pulls read ks->param; keep it tracking the latest round.
       ks->param.assign(msg.payload.begin(), msg.payload.end());
       ks->param_init = true;
+      ks->last_bcast_round = round;  // bcast-pull replay fallback
       int waiters = po_->num_workers() - 1;
       if (waiters > 0) {
         auto& br = ks->bcast_rounds[round];
@@ -427,6 +562,7 @@ void BytePSServer::Process(EngineTask&& task) {
       ack.sender = po_->my_id();
       ack.key = h.key;
       ack.req_id = h.req_id;
+      MarkReplied(ks, h.sender, h.req_id, ack);
       po_->van().Send(fd, ack);
       std::vector<std::pair<int, MsgHeader>> still_waiting;
       for (auto& p : ks->pending_bcast_pulls) {
@@ -471,20 +607,27 @@ bool BytePSServer::ReplyPull(KeyStore* ks, int slot, const EngineTask& t) {
     BPS_METRIC_COUNTER_ADD(
         "bps_server_reply_bytes_total",
         static_cast<int64_t>(ks->comp_reply[slot].size()));
+    MarkReplied(ks, req.sender, req.req_id, resp);
     SendReply(t, resp, ks->comp_reply[slot].data(),
               ks->comp_reply[slot].size());
   } else {
     BPS_METRIC_COUNTER_ADD("bps_server_reply_bytes_total",
                            static_cast<int64_t>(ks->slot[slot].size()));
+    MarkReplied(ks, req.sender, req.req_id, resp);
     SendReply(t, resp, ks->slot[slot].data(), ks->slot[slot].size());
   }
   if (++ks->pull_count[slot] == po_->num_workers()) {
-    // Round fully served; recycle the slot for round r+2.
+    // Round fully served; recycle the slot for round r+2. The slot's
+    // DATA (and cached compressed encode) are deliberately retained:
+    // they are the replay window for a pull whose response was lost in
+    // flight (AnswerDuplicate serves them again until the next round
+    // assigns over them — which per-key chaining delays until every
+    // worker provably received this round).
+    ks->last_round[slot] = ks->round[slot];
     ks->push_count[slot] = 0;
     ks->pull_count[slot] = 0;
     ks->ready[slot] = false;
     ks->round[slot] = -1;
-    ks->comp_reply[slot].clear();
     return true;
   }
   return false;
@@ -497,6 +640,10 @@ void BytePSServer::ReplayParked(KeyStore* ks, int slot) {
   auto parked = std::move(ks->parked_pushes[slot]);
   ks->parked_pushes[slot].clear();
   for (auto& t : parked) {
+    // The replay is the ORIGINAL request completing, not a wire
+    // duplicate — it must bypass the dedup window its first arrival
+    // recorded (and keep bypassing it if it re-parks).
+    t.from_park = true;
     Process(std::move(t));
   }
 }
@@ -522,6 +669,7 @@ void BytePSServer::ServeBcastRound(KeyStore* ks, int round, int fd,
   resp.req_id = req.req_id;
   resp.dtype = ks->dtype;
   resp.version = round;
+  MarkReplied(ks, req.sender, req.req_id, resp);
   po_->van().Send(fd, resp, it->second.data.data(), it->second.data.size());
   if (++it->second.served >= po_->num_workers() - 1) {
     ks->bcast_rounds.erase(it);

@@ -79,9 +79,47 @@ class BytePSServer {
     // (ack-on-park, see Process CMD_PUSH): the parked replay must not
     // reply a second time.
     bool replied = false;
+    // Set by ReplayParked: a parked task re-entering Process is the
+    // ORIGINAL request being completed, not a wire duplicate — it must
+    // bypass the dedup window its own first arrival recorded.
+    bool from_park = false;
   };
 
   struct KeyStore {
+    // Idempotent-retry dedup window (ISSUE 3): per sender, the last
+    // data-plane request seen for this key. Per key per sender at most
+    // ONE request chain is outstanding (the worker's per-key ordering
+    // invariant), so a single record per sender is a complete window:
+    // a request whose req_id matches the record is a wire duplicate
+    // (chaos dup, or a retry resend) — it is acked/served again from
+    // recorded state but NEVER re-applied, which is what keeps chaos
+    // runs bit-identical to fault-free runs. An unreplied match (the
+    // original is parked) answers CMD_KEEPALIVE so the worker's retry
+    // budget never expires on a legitimately slow round. Header-only
+    // state: pull replays re-serve from the slot/param buffers (see
+    // last_round below), so the window costs no payload copies.
+    // Touched only by this key's engine thread (hash routing).
+    struct SenderRec {
+      int32_t req_id = -1;
+      bool replied = false;
+      MsgHeader reply_head{};
+    };
+    std::unordered_map<int, SenderRec> seen;
+    // Round a recycled slot LAST served, and its data retained: a
+    // replayed sync pull whose PULL_RESP was lost can be re-served
+    // from slot[s]/comp_reply[s] until the slot is reassigned — which
+    // per-key chaining guarantees cannot happen before every worker
+    // completed that round's pull (round r+2's first push needs all
+    // r+1 pushes, which need all r pulls delivered). The one corner
+    // that CAN outrun this window — deep pipelining parking r+2's
+    // push before our round-r reply was delivered — is detected and
+    // fail-stopped with a wire CMD_ERROR instead of serving stale
+    // bytes (see Process CMD_PULL).
+    int last_round[2] = {-1, -1};
+    // Latest broadcast round pushed (bcast replay fallback: param
+    // still holds exactly that round's bytes).
+    int last_bcast_round = -1;
+
     int64_t len = 0;  // decompressed payload bytes
     int32_t dtype = BPS_FLOAT32;
     std::string comp_config;
@@ -129,6 +167,17 @@ class BytePSServer {
 
   void EngineLoop(int tid);
   void Process(EngineTask&& task);
+  // Dedup-window hit: answer a wire duplicate from recorded state
+  // (re-ack / re-serve / keepalive) without touching key state.
+  void AnswerDuplicate(KeyStore* ks, KeyStore::SenderRec& rec,
+                       EngineTask& task);
+  // Server -> worker control frames outside the reply tables.
+  void SendKeepalive(const EngineTask& t);
+  void SendWireError(int fd, const MsgHeader& req, const std::string& why);
+  // Close the dedup-window entry for (sender, req_id) with the reply
+  // header just sent, so a later wire duplicate replays it.
+  void MarkReplied(KeyStore* ks, int32_t sender, int32_t req_id,
+                   const MsgHeader& reply_head);
   // Fused-frame entry (van thread): unpack, account, fan sub-operations
   // out to their keys' engine threads under a shared MultiReply.
   void HandleMulti(Message&& msg, int fd);

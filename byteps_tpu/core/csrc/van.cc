@@ -58,6 +58,56 @@ static void LogMsg(const char* dir, int fd, const MsgHeader& h,
   }
 }
 
+// --- chaos injection (BYTEPS_CHAOS_*) ---------------------------------------
+// Deterministic transient-fault injection on the send path, for the
+// fault-tolerance test harness (docs/troubleshooting.md "failure
+// model"). Applies ONLY to data-plane frames (IsDataPlaneCmd): dropping
+// control traffic would fake node deaths instead of exercising the
+// in-band retry/reconnect machinery. Zero overhead when off: one branch
+// on a cached flag per send. All faults are injected under the per-fd
+// send lock from a seeded per-connection PRNG, so a fixed seed gives a
+// reproducible fault pattern per connection.
+struct ChaosCfg {
+  bool on = false;
+  uint64_t seed = 0;
+  double drop = 0.0;       // P(frame silently not written)
+  double dup = 0.0;        // P(frame written twice back-to-back)
+  int64_t delay_us = 0;    // fixed extra latency per data frame
+  int64_t reset_every = 0; // force a connection reset every N data frames
+};
+
+static const ChaosCfg& Chaos() {
+  static const ChaosCfg cfg = [] {
+    ChaosCfg c;
+    auto envf = [](const char* n) {
+      const char* v = getenv(n);
+      return v && *v ? atof(v) : 0.0;
+    };
+    auto envll = [](const char* n) {
+      const char* v = getenv(n);
+      return v && *v ? atoll(v) : 0ll;
+    };
+    c.drop = envf("BYTEPS_CHAOS_DROP");
+    c.dup = envf("BYTEPS_CHAOS_DUP");
+    c.delay_us = envll("BYTEPS_CHAOS_DELAY_US");
+    c.reset_every = envll("BYTEPS_CHAOS_RESET_EVERY");
+    c.seed = static_cast<uint64_t>(envll("BYTEPS_CHAOS_SEED"));
+    c.on = c.drop > 0 || c.dup > 0 || c.delay_us > 0 || c.reset_every > 0;
+    return c;
+  }();
+  return cfg;
+}
+
+// splitmix64 step: uniform in [0,1). Good enough for fault dice; cheap
+// and dependency-free.
+static double ChaosRand(uint64_t* state) {
+  uint64_t z = (*state += 0x9E3779B97F4A7C15ull);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  z ^= z >> 31;
+  return static_cast<double>(z >> 11) * (1.0 / 9007199254740992.0);
+}
+
 // Size data-connection socket buffers for high-bandwidth-delay links
 // (DCN between TPU pods and PS racks): the kernel default (~200 KB) caps
 // a 100 Gbit/s x 1 ms path at ~1.6 Gbit/s per connection. Tunable via
@@ -333,16 +383,17 @@ int Van::Listen(int port) {
   return bound;
 }
 
-int Van::Connect(const std::string& host, int port) {
+int Van::Connect(const std::string& host, int port, int max_attempts) {
   addrinfo hints{}, *res = nullptr;
   hints.ai_family = AF_INET;
   hints.ai_socktype = SOCK_STREAM;
   std::string port_s = std::to_string(port);
   // Retry: the peer may not have bound its listener yet (startup races are
   // normal — the reference's ps-lite retries its scheduler dial the same way).
-  for (int attempt = 0; attempt < 300; ++attempt) {
+  for (int attempt = 0; attempt < max_attempts; ++attempt) {
+    if (attempt > 0) usleep(100 * 1000);
+    if (stop_.load()) break;
     if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0) {
-      usleep(100 * 1000);
       continue;
     }
     int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
@@ -361,10 +412,9 @@ int Van::Connect(const std::string& host, int port) {
     if (fd >= 0) ::close(fd);
     freeaddrinfo(res);
     res = nullptr;
-    usleep(100 * 1000);
   }
   BPS_LOG(WARNING) << "van connect to " << host << ":" << port
-                   << " failed after retries";
+                   << " failed after " << max_attempts << " attempt(s)";
   return -1;
 }
 
@@ -388,6 +438,7 @@ bool Van::SendV(int fd, const MsgHeader& head, const struct iovec* segs,
   std::shared_ptr<std::mutex> smu;
   std::shared_ptr<ShmConn> shm;
   std::shared_ptr<ZcState> zcs;
+  std::shared_ptr<TxState> tx;
   {
     std::lock_guard<std::mutex> lk(mu_);
     auto it = send_mu_.find(fd);
@@ -397,8 +448,69 @@ bool Van::SendV(int fd, const MsgHeader& head, const struct iovec* segs,
     if (sit != shm_conns_.end()) shm = sit->second;
     auto zit = zc_.find(fd);
     if (zit != zc_.end()) zcs = zit->second;
+    auto tit = tx_.find(fd);
+    if (tit != tx_.end()) tx = tit->second;
   }
   std::lock_guard<std::mutex> lk(*smu);
+  // Per-connection monotone frame sequence, stamped under the per-fd
+  // send lock (so seq order == wire order). A chaos-duplicated frame
+  // carries the SAME seq — it is the same frame delivered twice.
+  if (tx) h.seq = ++tx->seq;
+  // Chaos injection point (data-plane frames only; see Chaos()).
+  int sends = 1;
+  if (tx && Chaos().on && IsDataPlaneCmd(h.cmd)) {
+    const ChaosCfg& c = Chaos();
+    ++tx->data_frames;
+    if (c.reset_every > 0 && tx->data_frames % c.reset_every == 0) {
+      // Forced connection reset: kill the socket mid-protocol. The
+      // local recv thread wakes with EOF -> disconnect handler ->
+      // reconnect-with-backoff; this send reports failure like any
+      // send into a dead connection (the retry layer re-issues it).
+      BPS_METRIC_COUNTER_ADD("bps_chaos_injected_total", 1);
+      BPS_METRIC_COUNTER_ADD("bps_chaos_reset_total", 1);
+      if (VerboseLevel() >= 2) {
+        fprintf(stderr, "[PS_VERBOSE] van CHAOS reset fd=%d\n", fd);
+      }
+      ::shutdown(fd, SHUT_RDWR);
+      return false;
+    }
+    if (c.delay_us > 0) {
+      BPS_METRIC_COUNTER_ADD("bps_chaos_injected_total", 1);
+      BPS_METRIC_COUNTER_ADD("bps_chaos_delay_total", 1);
+      usleep(static_cast<useconds_t>(c.delay_us));
+    }
+    if (c.drop > 0 && ChaosRand(&tx->rng) < c.drop) {
+      // Silent loss: report success, write nothing. Only the retry
+      // layer's timeout can recover the frame — exactly the contract
+      // under test.
+      BPS_METRIC_COUNTER_ADD("bps_chaos_injected_total", 1);
+      BPS_METRIC_COUNTER_ADD("bps_chaos_drop_total", 1);
+      if (VerboseLevel() >= 2) {
+        fprintf(stderr, "[PS_VERBOSE] van CHAOS drop fd=%d cmd=%d "
+                "seq=%lld\n", fd, h.cmd, (long long)h.seq);
+      }
+      return true;
+    }
+    if (c.dup > 0 && ChaosRand(&tx->rng) < c.dup) {
+      BPS_METRIC_COUNTER_ADD("bps_chaos_injected_total", 1);
+      BPS_METRIC_COUNTER_ADD("bps_chaos_dup_total", 1);
+      sends = 2;  // duplicate delivery, back-to-back, same seq
+    }
+  }
+  bool ok = true;
+  for (int send_i = 0; send_i < sends && ok; ++send_i) {
+    ok = WriteFrame(fd, h, segs, nsegs, total, payload_len, shm.get(),
+                    zcs.get());
+  }
+  return ok;
+}
+
+// One framed write on the already-locked connection: transport selection
+// (shm ring / zerocopy / gather writev) exactly as before the chaos
+// layer; factored out so a chaos-duplicated frame can be written twice.
+bool Van::WriteFrame(int fd, MsgHeader& h, const struct iovec* segs,
+                     int nsegs, uint64_t total, int64_t payload_len,
+                     ShmConn* shm, ZcState* zcs) {
   // Under the per-fd send lock so the PS_VERBOSE trace order matches the
   // actual wire order (the whole point of a message trace).
   LogMsg("send", fd, h, payload_len);
@@ -518,8 +630,17 @@ std::shared_ptr<std::mutex> Van::StartRecvThread(int fd) {
                           "unsupported; staying on copying sends";
     }
   }
+  auto tx = std::make_shared<TxState>();
+  {
+    // Seed the chaos PRNG per connection: deterministic for a fixed
+    // BYTEPS_CHAOS_SEED, decorrelated across connections.
+    static std::atomic<uint64_t> conn_idx{0};
+    tx->rng = (Chaos().seed + 1) * 0x9E3779B97F4A7C15ull +
+              conn_idx.fetch_add(1);
+  }
   std::lock_guard<std::mutex> lk(mu_);
   send_mu_[fd] = smu;
+  tx_[fd] = tx;
   if (zcs) zc_[fd] = zcs;
   threads_.emplace_back([this, fd] { RecvLoop(fd); });
   return smu;
@@ -567,12 +688,25 @@ static bool ReadFrame(ReadFn&& rd, Message* msg) {
   return true;
 }
 
-void Van::DispatchFrame(Message&& msg, int fd) {
+void Van::DispatchFrame(Message&& msg, int fd, int64_t* last_seq) {
   int64_t plen = msg.head.payload_len;
   bytes_recv_.fetch_add(
       static_cast<int64_t>(sizeof(uint64_t) + sizeof(MsgHeader) + plen),
       std::memory_order_relaxed);
   BPS_METRIC_COUNTER_ADD("bps_van_recv_frames_total", 1);
+  // Frame-loss observability from the per-connection seq: a jump means
+  // frames vanished between sender stamping and this reader (chaos
+  // drop); a repeat is a duplicate delivery. Cursor is the single recv
+  // thread's local, so no locking.
+  if (msg.head.seq > 0 && last_seq) {
+    if (msg.head.seq == *last_seq) {
+      BPS_METRIC_COUNTER_ADD("bps_seq_dups_total", 1);
+    } else if (*last_seq > 0 && msg.head.seq > *last_seq + 1) {
+      BPS_METRIC_COUNTER_ADD("bps_seq_gaps_total",
+                             msg.head.seq - *last_seq - 1);
+    }
+    if (msg.head.seq > *last_seq) *last_seq = msg.head.seq;
+  }
   LogMsg("recv", fd, msg.head, plen);
   if (msg.head.cmd == CMD_SHM_HELLO) {
     // Van-internal: the peer created a shm segment for this connection.
@@ -585,12 +719,13 @@ void Van::DispatchFrame(Message&& msg, int fd) {
 }
 
 void Van::RecvLoop(int fd) {
+  int64_t last_seq = 0;
   while (!stop_.load()) {
     Message msg;
     if (!ReadFrame([fd](void* b, size_t n) { return RecvAll(fd, b, n); },
                    &msg))
       break;
-    DispatchFrame(std::move(msg), fd);
+    DispatchFrame(std::move(msg), fd, &last_seq);
   }
   // A live-van exit means the PEER went away (EOF / reset), not Stop():
   // let the upper layer fail that peer's outstanding requests now.
@@ -764,6 +899,7 @@ void Van::AttachShm(int fd, const Message& hello) {
 // notification, and the fd itself closes when its last user thread
 // (this loop or the TCP recv thread via CloseConn) releases it.
 void Van::ShmRecvLoop(int fd, std::shared_ptr<ShmConn> conn) {
+  int64_t last_seq = 0;
   while (!stop_.load()) {
     Message msg;
     if (!ReadFrame(
@@ -773,7 +909,7 @@ void Van::ShmRecvLoop(int fd, std::shared_ptr<ShmConn> conn) {
             },
             &msg))
       break;
-    DispatchFrame(std::move(msg), fd);
+    DispatchFrame(std::move(msg), fd, &last_seq);
   }
   if (conn->fd_users.fetch_sub(1) == 1) ::close(fd);
 }
@@ -793,6 +929,7 @@ void Van::CloseConn(int fd) {
       shm_conns_.erase(it);
     }
     zc_.erase(fd);
+    tx_.erase(fd);
     if (send_mu_.erase(fd) && !shm) ::close(fd);
   }
   // Outside mu_: wakes the shm recv thread (and any blocked producer in

@@ -46,7 +46,11 @@ class Van {
   int Listen(int port);
 
   // Connect to a remote listener. Returns the connection fd (or -1).
-  int Connect(const std::string& host, int port);
+  // max_attempts bounds the dial loop (100 ms between tries): the
+  // default rides out fleet-formation races like the reference; the
+  // RECONNECT path (postoffice) passes 1 per try and owns its own
+  // backoff, so a dead peer is detected in milliseconds, not 30 s.
+  int Connect(const std::string& host, int port, int max_attempts = 300);
 
   // Send one framed message; thread-safe per connection. Payload bytes are
   // written straight from `payload` (zero-copy gather write).
@@ -86,7 +90,22 @@ class Van {
     uint32_t next = 0;              // zerocopy sends issued on this fd
     uint32_t reaped = 0xFFFFFFFFu;  // highest completed (-1 = none yet)
   };
+  // Per-connection transmit state, mutated only under the per-fd send
+  // lock: the monotone frame sequence (MsgHeader::seq) plus the chaos
+  // layer's deterministic PRNG and data-frame counter
+  // (BYTEPS_CHAOS_SEED/_DROP/_DELAY_US/_DUP/_RESET_EVERY; van.cc).
+  struct TxState {
+    int64_t seq = 0;
+    uint64_t rng = 0;
+    int64_t data_frames = 0;
+  };
 
+  // One framed write on an already-locked connection (transport
+  // selection: shm ring / zerocopy / gather writev). Factored out of
+  // SendV so the chaos layer can write a duplicated frame twice.
+  bool WriteFrame(int fd, MsgHeader& h, const struct iovec* segs,
+                  int nsegs, uint64_t total, int64_t payload_len,
+                  ShmConn* shm, ZcState* zcs);
   void AcceptLoop();
   void RecvLoop(int fd);
   // Returns the per-fd send mutex it registered — an identity token for
@@ -95,9 +114,11 @@ class Van {
   std::shared_ptr<std::mutex> StartRecvThread(int fd);
   void ShmRecvLoop(int fd, std::shared_ptr<ShmConn> conn);
   // Shared tail of both recv loops: wire accounting, PS_VERBOSE trace,
-  // van-internal command handling, handler dispatch — ONE copy so the
-  // transports cannot drift.
-  void DispatchFrame(Message&& msg, int fd);
+  // seq gap/dup detection, van-internal command handling, handler
+  // dispatch — ONE copy so the transports cannot drift. `last_seq` is
+  // the caller recv loop's per-connection cursor (each connection has
+  // exactly one frame consumer thread per transport).
+  void DispatchFrame(Message&& msg, int fd, int64_t* last_seq);
   // Connector side; returns false -> stay on TCP. `smu` is the send-mutex
   // identity StartRecvThread returned for this connection.
   bool OfferShm(int fd, const std::shared_ptr<std::mutex>& smu);
@@ -119,6 +140,10 @@ class Van {
   std::unordered_map<int, std::shared_ptr<ShmConn>> shm_conns_;
   // fds armed for MSG_ZEROCOPY sends (SO_ZEROCOPY accepted at setup).
   std::unordered_map<int, std::shared_ptr<ZcState>> zc_;
+  // Per-fd transmit state (seq stamping + chaos); created with the
+  // connection, looked up in SendV under the same mu_ acquisition as
+  // send_mu_, mutated only under the per-fd send lock.
+  std::unordered_map<int, std::shared_ptr<TxState>> tx_;
   std::vector<std::thread> threads_;
 };
 

@@ -59,6 +59,14 @@ void BytePSWorker::Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
   Metrics::Get().Histogram("bps_fusion_batch_keys");
   Metrics::Get().Histogram("bps_push_us");
   Metrics::Get().Histogram("bps_pull_us");
+  // Transient-fault telemetry: present-from-zero so monitor.top and
+  // /healthz can watch a climbing retry rate BEFORE a node goes dead
+  // (docs/monitoring.md). bps_chaos_injected_total stays lazily
+  // registered — nonzero only when fault injection is armed.
+  Metrics::Get().Counter("bps_retries_total");
+  Metrics::Get().Counter("bps_reconnects_total");
+  Metrics::Get().Counter("bps_seq_gaps_total");
+  Metrics::Get().Counter("bps_seq_dups_total");
   // Reference semantics: BYTEPS_SCHEDULING_CREDIT is an in-flight BYTE
   // budget. 0 = auto: four full partitions' worth. A value under 1024
   // can only be a legacy partition count (the reference default was 4;
@@ -506,7 +514,14 @@ static const SubHeader* ParseMultiReply(const Message& m, int expect_cmd,
 void BytePSWorker::SendFusedPush(int server_id, std::vector<PushOp> ops) {
   const int n = static_cast<int>(ops.size());
   auto batch = std::make_shared<std::vector<PushOp>>(std::move(ops));
-  std::vector<SubHeader> table(static_cast<size_t>(n));
+  // shared_ptr table: the retry layer may resend this frame after
+  // SendFusedPush returned, so the sub-header table must live until the
+  // request settles (passed to RequestV as the lifetime hold). The
+  // sub-payload segments already do — they point into caller buffers /
+  // comp_bufs pinned until the handles complete.
+  auto table_hold = std::make_shared<std::vector<SubHeader>>(
+      static_cast<size_t>(n));
+  std::vector<SubHeader>& table = *table_hold;
   std::vector<iovec> segs;
   segs.reserve(static_cast<size_t>(n) + 1);
   segs.push_back({table.data(),
@@ -541,13 +556,15 @@ void BytePSWorker::SendFusedPush(int server_id, std::vector<PushOp> ops) {
   BPS_METRIC_COUNTER_ADD("bps_fused_msgs_total", 1);
   BPS_METRIC_HISTO_OBSERVE("bps_fusion_batch_keys", n);
   int64_t t_push = NowUs();
-  // The table and iovec list live only until RequestV returns — the van
-  // writes synchronously; the payload segments themselves live in caller
-  // buffers / comp_bufs until the handles settle.
+  // The iovec list lives only until RequestV returns (it snapshots the
+  // segments when retry is on); the table is pinned via the hold, the
+  // payload segments via caller buffers / comp_bufs until the handles
+  // settle.
   kv_->RequestV(server_id, h, segs.data(), static_cast<int>(segs.size()),
                 [this, server_id, batch, t_push](Message&& ack) {
                   OnFusedAck(server_id, batch, t_push, std::move(ack));
-                });
+                },
+                table_hold);
 }
 
 void BytePSWorker::OnFusedAck(
@@ -562,7 +579,11 @@ void BytePSWorker::OnFusedAck(
   const SubHeader* subs = ParseMultiReply(ack, CMD_MULTI_ACK, n, &gathered);
   auto at_push = std::make_shared<std::vector<int64_t>>(
       static_cast<size_t>(n), 0);
-  std::vector<SubHeader> table(static_cast<size_t>(n));
+  // shared_ptr table: pinned past this callback for the retry layer's
+  // resends (same contract as SendFusedPush).
+  auto table_hold = std::make_shared<std::vector<SubHeader>>(
+      static_cast<size_t>(n));
+  std::vector<SubHeader>& table = *table_hold;
   for (int i = 0; i < n; ++i) {
     PushOp& op = (*batch)[i];
     BPS_CHECK_EQ(subs[i].key, op.p->key) << "fused ack table out of order";
@@ -589,7 +610,8 @@ void BytePSWorker::OnFusedAck(
   kv_->RequestV(server_id, h, &seg, 1,
                 [this, batch, at_push, t_pull](Message&& resp) {
                   OnFusedPullResp(batch, at_push, t_pull, std::move(resp));
-                });
+                },
+                table_hold);
 }
 
 void BytePSWorker::OnFusedPullResp(

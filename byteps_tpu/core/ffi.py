@@ -87,6 +87,8 @@ def _load() -> ctypes.CDLL:
     lib.bps_metrics_observe.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                         ctypes.c_longlong]
     lib.bps_metrics_observe.restype = ctypes.c_int
+    lib.bps_failure_shutdown.argtypes = []
+    lib.bps_failure_shutdown.restype = ctypes.c_int
     _lib = lib
     return lib
 
@@ -152,6 +154,17 @@ def _apply_config_env(cfg: Optional[Config]) -> None:
     os.environ["BYTEPS_TRACE_ON"] = "1" if cfg.trace_on else "0"
     os.environ["BYTEPS_MONITOR_ON"] = "1" if cfg.monitor_on else "0"
     os.environ["BYTEPS_MONITOR_PORT"] = str(cfg.monitor_port)
+    # Transient-fault tolerance + chaos harness (the C core reads these
+    # at init; docs/env.md "Fault tolerance and chaos injection").
+    os.environ["BYTEPS_RETRY_MAX"] = str(cfg.retry_max)
+    os.environ["BYTEPS_RETRY_TIMEOUT_MS"] = str(cfg.retry_timeout_ms)
+    os.environ["BYTEPS_RECONNECT_MAX"] = str(cfg.reconnect_max)
+    os.environ["BYTEPS_RECONNECT_BACKOFF_MS"] = str(cfg.reconnect_backoff_ms)
+    os.environ["BYTEPS_CHAOS_SEED"] = str(cfg.chaos_seed)
+    os.environ["BYTEPS_CHAOS_DROP"] = str(cfg.chaos_drop)
+    os.environ["BYTEPS_CHAOS_DUP"] = str(cfg.chaos_dup)
+    os.environ["BYTEPS_CHAOS_DELAY_US"] = str(cfg.chaos_delay_us)
+    os.environ["BYTEPS_CHAOS_RESET_EVERY"] = str(cfg.chaos_reset_every)
 
 
 class _Node:
@@ -191,6 +204,14 @@ class _Node:
 
     # Scheduler/Server block here until the fleet shuts down.
     run = shutdown
+
+    def failure_shutdown(self) -> bool:
+        """True when this node's shutdown was FAILURE-triggered (the
+        scheduler's dead-node broadcast, or a lost scheduler
+        connection) rather than the clean all-goodbyes teardown.
+        Valid after shutdown(); the server entry point exits nonzero
+        on it so supervisors can tell crash from completion."""
+        return bool(self._lib.bps_failure_shutdown())
 
     def metrics_snapshot(self) -> dict:
         """Full telemetry snapshot for this node (see metrics_snapshot)."""

@@ -240,6 +240,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "times after a failed run (elastic-ish recovery: "
                         "pair the training script with checkpoint/resume "
                         "so restarts continue from the last step)")
+    p.add_argument("--restart-backoff", type=float, metavar="SECONDS",
+                   default=1.0,
+                   help="base delay before each --restarts relaunch, "
+                        "doubled per consecutive failed attempt (capped "
+                        "at 30 s): a crash-looping fleet must not hammer "
+                        "ports/scheduler at full speed (default 1.0)")
+    p.add_argument("--chaos", metavar="SPEC", default="",
+                   help="arm the deterministic fault-injection layer for "
+                        "the whole fleet: comma-separated knobs "
+                        "drop=P,dup=P,delay-us=N,reset-every=N,seed=N "
+                        "(sets BYTEPS_CHAOS_*; e.g. --chaos "
+                        "drop=0.01,reset-every=1000,seed=42). Requires "
+                        "the retry layer (BYTEPS_RETRY_MAX > 0, the "
+                        "default); see docs/troubleshooting.md")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="worker command, e.g. python train.py")
     args = p.parse_args(argv)
@@ -251,17 +265,41 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         os.environ["BYTEPS_MONITOR_PORT"] = str(args.monitor_port)
     if args.fusion_bytes >= 0:
         os.environ["BYTEPS_FUSION_BYTES"] = str(args.fusion_bytes)
+    if args.chaos:
+        chaos_envs = {"drop": "BYTEPS_CHAOS_DROP",
+                      "dup": "BYTEPS_CHAOS_DUP",
+                      "delay-us": "BYTEPS_CHAOS_DELAY_US",
+                      "reset-every": "BYTEPS_CHAOS_RESET_EVERY",
+                      "seed": "BYTEPS_CHAOS_SEED"}
+        for item in args.chaos.split(","):
+            key, sep, val = item.partition("=")
+            key = key.strip().lower()
+            if not sep or key not in chaos_envs:
+                p.error(f"--chaos: unknown knob {item!r} (expected "
+                        f"{'/'.join(sorted(chaos_envs))}=value)")
+            os.environ[chaos_envs[key]] = val.strip()
 
     if args.local:
         if not command:
             p.error("--local requires a worker command")
+        import time
+
         rc = launch_local_fleet(command, args.local, args.num_servers,
                                 args.port, dict(os.environ), numa=args.numa)
         for attempt in range(args.restarts):
             if rc == 0:
                 break
+            # Capped exponential backoff between relaunches: a
+            # crash-looping fleet (bad config, dead dependency) must not
+            # hammer the scheduler port / cluster manager at full speed,
+            # and TIME_WAIT sockets from the failed fleet get a chance
+            # to clear.
+            delay = min(args.restart_backoff * (2 ** attempt), 30.0)
             print(f"bpslaunch: fleet failed (exit {rc}); restart "
-                  f"{attempt + 1}/{args.restarts}", file=sys.stderr)
+                  f"{attempt + 1}/{args.restarts} in {delay:.1f}s",
+                  file=sys.stderr)
+            if delay > 0:
+                time.sleep(delay)
             rc = launch_local_fleet(command, args.local, args.num_servers,
                                     args.port, dict(os.environ),
                                     numa=args.numa)

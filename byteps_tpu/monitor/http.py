@@ -35,6 +35,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 snap = _metrics.snapshot()
                 dead = snap.get("dead_nodes", [])
                 node = snap.get("node", {})
+                counters = snap.get("counters", {})
                 healthy = bool(node.get("inited")) and not dead
                 body = json.dumps({
                     "status": "ok" if healthy else "degraded",
@@ -42,6 +43,12 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     "role": node.get("role"),
                     "node_id": node.get("id"),
                     "dead_nodes": dead,
+                    # Transient-fault telemetry (docs/troubleshooting.md
+                    # failure model): a climbing retry/reconnect rate is
+                    # the early-warning signal BEFORE a node goes dead.
+                    "retries": int(counters.get("bps_retries_total", 0)),
+                    "reconnects": int(
+                        counters.get("bps_reconnects_total", 0)),
                     "uptime_s": round(
                         time.monotonic() - self.server.started_at, 3),
                 }).encode()

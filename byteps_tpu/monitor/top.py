@@ -85,7 +85,19 @@ def analyze(scrapes: Dict[str, Optional[dict]],
             "inflight_bytes": int(_sample(m, "bps_queue_inflight_bytes")),
             "credit_budget_bytes": int(
                 _sample(m, "bps_queue_credit_budget_bytes")),
+            # Transient-fault telemetry: nonzero means this worker is
+            # absorbing faults in-band (resends / re-dialled server
+            # connections) — the flag to investigate a link or peer
+            # BEFORE the node goes dead.
+            "retries": int(_sample(m, "bps_retries_total")),
+            "reconnects": int(_sample(m, "bps_reconnects_total")),
         }
+
+    # A worker actively riding the retry layer is flagged separately
+    # from stragglers: its latency may still look healthy while its
+    # connection quality is not.
+    retrying = sorted(n for n, w in workers.items()
+                      if w["retries"] > 0 or w["reconnects"] > 0)
 
     stragglers: List[str] = []
     active = {n: w["push_mean_us"] for n, w in workers.items()
@@ -111,6 +123,7 @@ def analyze(scrapes: Dict[str, Optional[dict]],
         "workers": workers,
         "baseline_push_us": baseline_us,
         "stragglers": sorted(stragglers),
+        "retrying": retrying,
         "stale_nodes": sorted(stale_nodes),
         "dead_nodes": sorted(dead_nodes),
         "unreachable": sorted(n for n, m in scrapes.items() if m is None),
@@ -122,18 +135,24 @@ def _print_report(report: dict, as_json: bool) -> None:
         print(json.dumps(report))
         return
     print(f"{'worker':<10} {'push/s':>8} {'push MB':>9} {'pull MB':>9} "
-          f"{'mean push':>10} {'queue':>6} {'credit':>14} flags")
+          f"{'mean push':>10} {'queue':>6} {'credit':>14} {'rtry':>5} "
+          f"{'reconn':>6} flags")
     for name in sorted(report["workers"]):
         w = report["workers"][name]
-        flags = "STRAGGLER" if name in report["stragglers"] else ""
+        flags = []
+        if name in report["stragglers"]:
+            flags.append("STRAGGLER")
+        if name in report.get("retrying", []):
+            flags.append("RETRYING")
         credit = (f"{w['inflight_bytes'] >> 10}/"
                   f"{w['credit_budget_bytes'] >> 10}K")
         print(f"{name:<10} {w['push_count']:>8} "
               f"{w['push_bytes'] / 1e6:>9.2f} {w['pull_bytes'] / 1e6:>9.2f} "
               f"{w['push_mean_us'] / 1e3:>8.2f}ms {w['queue_pending']:>6} "
-              f"{credit:>14} {flags}")
-    for kind in ("stale_nodes", "dead_nodes", "unreachable"):
-        if report[kind]:
+              f"{credit:>14} {w.get('retries', 0):>5} "
+              f"{w.get('reconnects', 0):>6} {' '.join(flags)}")
+    for kind in ("retrying", "stale_nodes", "dead_nodes", "unreachable"):
+        if report.get(kind):
             print(f"{kind}: {report[kind]}")
 
 

@@ -32,3 +32,12 @@ def main() -> None:
     # Start() returns once the topology is up; shutdown() blocks until the
     # scheduler broadcasts fleet shutdown (worker goodbyes all received).
     node.shutdown()
+    # A FAILURE-triggered shutdown (dead-node broadcast / lost scheduler
+    # connection) exits nonzero so a supervisor can tell crash from
+    # completion. The scheduler itself stays 0 — detecting and
+    # broadcasting a failure IS its job done correctly (and the restart
+    # loop keys off the workers' exit codes).
+    if role == "server" and node.failure_shutdown():
+        print("byteps_tpu.server: failure shutdown (a node died); "
+              "exiting nonzero", file=sys.stderr, flush=True)
+        raise SystemExit(2)

@@ -607,6 +607,63 @@ def main() -> int:
                 w.wait(h)
                 np.testing.assert_array_equal(arr, expect)
 
+        elif mode == "chaos":
+            # Transient-fault tolerance acceptance (ISSUE 3): a
+            # multi-round, many-tensor training-shaped workload that the
+            # parent runs twice — chaos on (drop + dup + reset, fixed
+            # seed) and chaos off — and diffs. Integer-valued floats make
+            # the summation exact, so the digests must match BITWISE:
+            # every injected fault must be absorbed by retry/dedup/
+            # reconnect without double-applying a single push. Broadcast
+            # is included so the BCAST dedup paths are exercised too.
+            # Synchronous step pattern (wait each round), like real
+            # training — deep pipelining is outside the replay window's
+            # contract (docs/troubleshooting.md).
+            import json
+            import hashlib
+
+            sizes = [64, 96, 128, 192, 256, 384, 512, 768, 1024,
+                     1536] * 3  # 30 tensors, 256 B .. 6 KiB
+            tids = [w.declare(f"ch{i}", n, "float32", compression="")
+                    for i, n in enumerate(sizes)]
+            # Seed round: root broadcasts a known pattern.
+            bc = w.declare("ch_bc", 512, "float32", compression="")
+            arr_bc = (np.arange(512, dtype=np.float32) if rank == 0
+                      else np.zeros(512, np.float32))
+            w.wait(w.broadcast(bc, arr_bc, root_rank=0))
+            np.testing.assert_array_equal(
+                arr_bc, np.arange(512, dtype=np.float32))
+            digest = hashlib.sha256()
+            digest.update(arr_bc.tobytes())
+            scale = sum(r + 1 for r in range(nw))
+            for rnd in range(4):
+                staged = []
+                for i, (tid, n) in enumerate(zip(tids, sizes)):
+                    base = (np.arange(n) % 89 + i + rnd + 1).astype(
+                        np.float32)
+                    arr = np.ascontiguousarray(base * (rank + 1))
+                    staged.append((w.push_pull(tid, arr, average=False),
+                                   arr, base))
+                for h, arr, base in staged:
+                    w.wait(h)
+                    np.testing.assert_array_equal(arr, base * scale)
+                    digest.update(arr.tobytes())
+            w.barrier(GROUP_WORKERS)  # all counters final
+            snap = w.metrics_snapshot()["counters"]
+            print(json.dumps({
+                "digest": digest.hexdigest(),
+                "retries": snap.get("bps_retries_total", 0),
+                "reconnects": snap.get("bps_reconnects_total", 0),
+                "chaos_injected": snap.get("bps_chaos_injected_total", 0),
+                "chaos_drop": snap.get("bps_chaos_drop_total", 0),
+                "chaos_dup": snap.get("bps_chaos_dup_total", 0),
+                "chaos_reset": snap.get("bps_chaos_reset_total", 0),
+                "push_partitions": snap.get("bps_push_partitions_total",
+                                            0),
+                "push_bytes": snap.get("bps_push_bytes_total", 0),
+            }), flush=True)
+            w.barrier(GROUP_WORKERS)
+
         elif mode == "barrier":
             w.barrier(GROUP_WORKERS)
             print(f"rank {rank} passed barrier")

@@ -96,3 +96,55 @@ def test_invalid_role():
 def test_ps_mode_override():
     assert not Config(num_server=2, ps_mode="collective").use_ps
     assert Config(ps_mode="ps").use_ps
+
+
+def test_heartbeat_timeout_must_exceed_interval():
+    """ISSUE 3 satellite: a timeout at-or-below the interval declares
+    healthy nodes dead on their first missed tick — reject it at init
+    with the fix named, instead of letting the fleet kill itself."""
+    with pytest.raises(ValueError, match="PS_HEARTBEAT_TIMEOUT"):
+        Config(heartbeat_interval_s=5.0, heartbeat_timeout_s=5.0).validate()
+    with pytest.raises(ValueError, match="PS_HEARTBEAT_TIMEOUT"):
+        Config(heartbeat_interval_s=5.0, heartbeat_timeout_s=2.0).validate()
+    Config(heartbeat_interval_s=1.0, heartbeat_timeout_s=3.0).validate()
+    # Heartbeats disabled (<= 0): the relation is vacuous, any timeout ok.
+    Config(heartbeat_interval_s=0.0, heartbeat_timeout_s=0.0).validate()
+
+
+def test_retry_and_chaos_validation():
+    """Fault-tolerance knobs (ISSUE 3): ranges enforced, and chaos
+    injection refuses to arm without the retry layer that absorbs it."""
+    with pytest.raises(ValueError, match="BYTEPS_RETRY_MAX"):
+        Config(retry_max=-1).validate()
+    with pytest.raises(ValueError, match="BYTEPS_RETRY_TIMEOUT_MS"):
+        Config(retry_timeout_ms=5).validate()
+    with pytest.raises(ValueError, match="BYTEPS_RECONNECT_MAX"):
+        Config(reconnect_max=0).validate()
+    with pytest.raises(ValueError, match="BYTEPS_CHAOS_DROP"):
+        Config(chaos_drop=1.0).validate()
+    with pytest.raises(ValueError, match="BYTEPS_CHAOS_DUP"):
+        Config(chaos_dup=-0.1).validate()
+    with pytest.raises(ValueError, match="BYTEPS_CHAOS_RESET_EVERY"):
+        Config(chaos_reset_every=-1).validate()
+    # Chaos without retry would just crash the fleet at the first fault.
+    with pytest.raises(ValueError, match="BYTEPS_RETRY_MAX > 0"):
+        Config(chaos_drop=0.01, retry_max=0).validate()
+    # Retry off alone is a legal (documented) escape hatch...
+    Config(retry_max=0).validate()
+    # ...and delay-only chaos needs no retry (nothing is ever lost).
+    Config(chaos_delay_us=100, retry_max=0).validate()
+
+
+def test_chaos_env_roundtrip(monkeypatch):
+    monkeypatch.setenv("BYTEPS_CHAOS_SEED", "42")
+    monkeypatch.setenv("BYTEPS_CHAOS_DROP", "0.05")
+    monkeypatch.setenv("BYTEPS_CHAOS_DUP", "0.01")
+    monkeypatch.setenv("BYTEPS_CHAOS_DELAY_US", "250")
+    monkeypatch.setenv("BYTEPS_CHAOS_RESET_EVERY", "500")
+    monkeypatch.setenv("BYTEPS_RETRY_MAX", "6")
+    monkeypatch.setenv("BYTEPS_RETRY_TIMEOUT_MS", "400")
+    cfg = load_config()
+    assert cfg.chaos_seed == 42
+    assert cfg.chaos_drop == 0.05 and cfg.chaos_dup == 0.01
+    assert cfg.chaos_delay_us == 250 and cfg.chaos_reset_every == 500
+    assert cfg.retry_max == 6 and cfg.retry_timeout_ms == 400
