@@ -110,6 +110,21 @@ class Config:
     reconnect_backoff_ms: int = 100       # BYTEPS_RECONNECT_BACKOFF_MS
     #   base backoff between re-dials (doubles per attempt, capped 2 s)
 
+    # --- hot server replacement (ISSUE 4; docs/troubleshooting.md) ---------
+    recovery_timeout_ms: int = 60000      # BYTEPS_RECOVERY_TIMEOUT_MS
+    #   how long the scheduler holds the fleet in RECOVERY waiting for a
+    #   replacement server (DMLC_RECOVER_RANK) after a server's heartbeat
+    #   death, before falling back to the fleet-wide failure SHUTDOWN.
+    #   0 disables hot replacement (PR 3 fail-stop behavior wholesale).
+    #   BYTEPS_RETRY_MAX=0 also disables it implicitly: the re-seed
+    #   protocol rides the resend queue, so "retry off" keeps its
+    #   documented meaning of restoring pre-retry fail-fast wholesale
+    #   (see effective_recovery_timeout_ms)
+    recover_rank: Optional[int] = None    # DMLC_RECOVER_RANK
+    #   server-process only: adopt this dead server rank's id and key
+    #   shard instead of joining fleet formation (set by the supervisor
+    #   when respawning a dead server role)
+
     # --- chaos injection (deterministic fault harness; BYTEPS_CHAOS_*) -----
     chaos_seed: int = 0                   # BYTEPS_CHAOS_SEED
     chaos_drop: float = 0.0               # BYTEPS_CHAOS_DROP
@@ -141,6 +156,16 @@ class Config:
         """True when the DCN/PS leg is active (reference: BytePSGlobal's
         _is_distributed_job: num_server > 0 or BYTEPS_FORCE_DISTRIBUTED)."""
         return self.num_server > 0 or self.force_distributed
+
+    @property
+    def effective_recovery_timeout_ms(self) -> int:
+        """Recovery window the fleet actually runs with. Hot server
+        replacement rides the retry layer's resend queue, so
+        BYTEPS_RETRY_MAX=0 (the documented restore-fail-fast-wholesale
+        escape hatch) implies recovery off without needing
+        BYTEPS_RECOVERY_TIMEOUT_MS=0 to be set separately. This value —
+        not the raw knob — is what ffi projects to the C core."""
+        return 0 if self.retry_max == 0 else self.recovery_timeout_ms
 
     @property
     def use_ps(self) -> bool:
@@ -242,6 +267,52 @@ class Config:
                 "only the retry layer can absorb; they require "
                 "BYTEPS_RETRY_MAX > 0 (the combination would just crash "
                 "the fleet at the first injected fault)")
+        if self.recovery_timeout_ms < 0:
+            raise ValueError(
+                "BYTEPS_RECOVERY_TIMEOUT_MS must be >= 0 (0 disables hot "
+                "server replacement; a dead server then fail-stops the "
+                "fleet as before)")
+        if (self.effective_recovery_timeout_ms > 0
+                and self.heartbeat_interval_s > 0
+                and self.recovery_timeout_ms
+                <= self.heartbeat_timeout_s * 1000.0):
+            raise ValueError(
+                f"BYTEPS_RECOVERY_TIMEOUT_MS ({self.recovery_timeout_ms}) "
+                f"must exceed PS_HEARTBEAT_TIMEOUT "
+                f"({self.heartbeat_timeout_s}s): the replacement's own "
+                "startup + registration takes at least as long as a "
+                "heartbeat round trip, so a shorter window can only ever "
+                "time out into the fail-stop fallback")
+        if self.recover_rank is not None:
+            if self.effective_recovery_timeout_ms == 0:
+                raise ValueError(
+                    "DMLC_RECOVER_RANK is set but hot replacement is "
+                    "disabled (BYTEPS_RECOVERY_TIMEOUT_MS=0, or "
+                    "BYTEPS_RETRY_MAX=0 — re-seed rides the resend "
+                    "queue, so retry off implies recovery off) — the "
+                    "scheduler would reject the recovery registration")
+            if self.role != "server":
+                raise ValueError(
+                    "DMLC_RECOVER_RANK is a server-process knob (the "
+                    f"replacement adopts the dead rank); role is "
+                    f"{self.role!r}")
+            if not (0 <= self.recover_rank < max(self.num_server, 1)):
+                raise ValueError(
+                    f"DMLC_RECOVER_RANK={self.recover_rank} out of range: "
+                    f"the fleet has {self.num_server} server rank(s) "
+                    f"(valid: 0..{max(self.num_server - 1, 0)})")
+        if self.effective_recovery_timeout_ms > 0 and self.enable_async:
+            # Async mode keeps the authoritative accumulator SERVER-side;
+            # a dead server's param state is not reconstructible from
+            # workers, so recovery re-seeds nothing for async keys.
+            import warnings
+            warnings.warn(
+                "BYTEPS_ENABLE_ASYNC with hot server replacement: a "
+                "replaced server loses its async accumulator state "
+                "(workers hold no authoritative copy); async training "
+                "semantics after a recovery are undefined — set "
+                "BYTEPS_RECOVERY_TIMEOUT_MS=0 for async jobs",
+                stacklevel=2)
         if self.heartbeat_interval_s > 0 and \
                 self.heartbeat_timeout_s <= self.heartbeat_interval_s:
             # A timeout at-or-below the interval declares healthy nodes
@@ -295,6 +366,9 @@ def load_config() -> Config:
         retry_timeout_ms=_env_int("BYTEPS_RETRY_TIMEOUT_MS", 1000),
         reconnect_max=_env_int("BYTEPS_RECONNECT_MAX", 3),
         reconnect_backoff_ms=_env_int("BYTEPS_RECONNECT_BACKOFF_MS", 100),
+        recovery_timeout_ms=_env_int("BYTEPS_RECOVERY_TIMEOUT_MS", 60000),
+        recover_rank=(int(os.environ["DMLC_RECOVER_RANK"])
+                      if os.environ.get("DMLC_RECOVER_RANK") else None),
         chaos_seed=_env_int("BYTEPS_CHAOS_SEED", 0),
         chaos_drop=float(os.environ.get("BYTEPS_CHAOS_DROP", "0") or 0),
         chaos_dup=float(os.environ.get("BYTEPS_CHAOS_DUP", "0") or 0),

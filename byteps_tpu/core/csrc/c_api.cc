@@ -156,10 +156,20 @@ int bps_init(int role) {
     gl->po->SetPeerReconnectedCallback([gl](int node_id) {
       gl->kv->ResendNode(node_id);
     });
-  }
-
-  int id = gl->po->Start(gl->role, uri, port, nw, ns, std::move(handler));
-  if (gl->role == ROLE_WORKER) {
+    // Hot server replacement (ISSUE 4): a dead server rank under
+    // scheduler-coordinated recovery freezes its retry clocks; the
+    // RESUME (replacement redialled) re-seeds the shard and drains the
+    // parked resend queue.
+    gl->po->SetPeerPausedCallback([gl](int node_id) {
+      gl->kv->PauseNode(node_id);
+    });
+    gl->po->SetPeerRecoveredCallback([gl](int node_id) {
+      gl->worker->OnServerRecovered(node_id);
+    });
+    // The worker pipeline exists BEFORE the postoffice starts (same
+    // reasoning as the server's engine threads above): recovery
+    // callbacks fire on van threads and must always find a live
+    // BytePSWorker.
     gl->worker = std::make_unique<BytePSWorker>();
     gl->worker->Start(gl->po.get(), gl->kv.get(),
                       EnvInt64("BYTEPS_PARTITION_BYTES", 4096000),
@@ -171,6 +181,8 @@ int bps_init(int role) {
                       EnvInt("BYTEPS_FUSION_KEYS", 128),
                       DefaultCompConfig(), EnvBool("BYTEPS_TRACE_ON"));
   }
+
+  int id = gl->po->Start(gl->role, uri, port, nw, ns, std::move(handler));
   gl->inited = true;
   return id;
 }

@@ -87,6 +87,23 @@ enum Command : int32_t {
                              // budget instead of escalating to fail-stop
                              // (a parked pull can legitimately wait out
                              // many retry timeouts behind a slow peer).
+  // Hot server replacement (ISSUE 4): scheduler-coordinated recovery of
+  // a dead SERVER rank instead of the fleet-wide failure SHUTDOWN.
+  CMD_EPOCH_PAUSE = 22,      // scheduler -> all: a server rank died;
+                             // membership epoch bumped (arg0 = epoch,
+                             // arg1 = dead node id). Workers park that
+                             // rank's in-flight requests in the resend
+                             // queue and freeze their retry clocks.
+  CMD_EPOCH_RESUME = 23,     // scheduler -> all: a replacement adopted
+                             // the dead rank (arg0 = epoch, arg1 = node
+                             // id, payload = the replacement's
+                             // NodeInfo). Workers redial, re-seed the
+                             // shard, and drain the parked queue.
+  CMD_RESEED = 24,           // worker -> replacement server: re-seed one
+                             // key's latest COMPLETED round (version =
+                             // round, payload = the unscaled aggregate)
+                             // so pulls parked mid-round can be served
+                             // from the authoritative worker replica.
 };
 
 // Transient-fault tolerance: commands eligible for chaos injection,
@@ -102,6 +119,12 @@ inline bool IsDataPlaneCmd(int32_t cmd) {
     case CMD_MULTI_PUSH: case CMD_MULTI_ACK:
     case CMD_MULTI_PULL: case CMD_MULTI_PULL_RESP:
     case CMD_KEEPALIVE:
+    // RESEED rides the same retry/dedup machinery as a push (it is one):
+    // chaos may drop it, the retry layer re-delivers it, and re-applying
+    // it is idempotent (assignment of an already-final aggregate).
+    // EPOCH_PAUSE/RESUME are control-plane: losing one would strand the
+    // recovery, exactly like a lost heartbeat would fake a death.
+    case CMD_RESEED:
       return true;
     default:
       return false;

@@ -194,6 +194,7 @@ class KVWorker {
     {
       std::lock_guard<std::mutex> lk(mu_);
       dead_nodes_.insert(node_id);  // before the scan, same lock: no gap
+      paused_nodes_.erase(node_id);  // escalation ends any recovery park
       for (const auto& kv : pending_) {
         if (kv.second.node == node_id) rids.push_back(kv.first);
       }
@@ -277,19 +278,42 @@ class KVWorker {
     }
   }
 
+  // Hot server replacement (ISSUE 4): freeze the retry clock for every
+  // request addressed to `node_id` — they stay parked in the resend
+  // queue, neither resent nor escalated, until ResendNode (recovery
+  // complete) drains them, or the fleet's failure SHUTDOWN fail-stops
+  // them. Idempotent; invoked from the peer-paused callback.
+  void PauseNode(int node_id) {
+    if (retry_max_ <= 0) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    paused_nodes_.insert(node_id);
+  }
+
+  // True while request `rid` is still pending (unsettled). Used by the
+  // worker's recovery hook to tell "push settled but its callback has
+  // not run yet" (re-push needed) from "push still in the resend queue"
+  // (ResendNode re-delivers it).
+  bool HasPending(int rid) {
+    std::lock_guard<std::mutex> lk(mu_);
+    return pending_.count(rid) > 0;
+  }
+
   // Immediately re-issue every in-flight request addressed to `node_id`
   // over its (freshly reconnected) connection, instead of waiting out
   // each request's retry timeout. Invoked from the postoffice's
-  // peer-reconnected callback on a van thread.
+  // peer-reconnected callback on a van thread, and by the recovery hook
+  // after the replacement server was re-seeded (also lifts PauseNode).
   void ResendNode(int node_id) {
     if (retry_max_ <= 0) return;
     std::vector<Resend> work;
     {
       std::lock_guard<std::mutex> lk(mu_);
+      paused_nodes_.erase(node_id);
       for (auto& kv : pending_) {
         if (kv.second.node != node_id) continue;
         work.push_back(SnapshotForResend(kv.first, kv.second));
         kv.second.deadline_ms = NowMs() + retry_timeout_ms_;
+        kv.second.attempts = 0;  // fresh budget against the fresh peer
       }
     }
     if (!work.empty()) {
@@ -385,6 +409,10 @@ class KVWorker {
         int64_t now = NowMs();
         for (auto& kv : pending_) {
           PendingReq& pr = kv.second;
+          // A paused node's requests are parked, not overdue: their
+          // rank is mid-recovery and the scheduler owns escalation
+          // (replacement, or the failure-SHUTDOWN fallback).
+          if (paused_nodes_.count(pr.node)) continue;
           if (pr.deadline_ms <= 0 || now < pr.deadline_ms) continue;
           if (pr.attempts >= retry_max_) {
             exhausted.push_back(kv.first);
@@ -457,6 +485,7 @@ class KVWorker {
   std::condition_variable cv_;
   std::unordered_map<int, PendingReq> pending_;
   std::unordered_set<int> dead_nodes_;  // peers with lost connections
+  std::unordered_set<int> paused_nodes_;  // ranks mid-recovery (frozen)
   int next_req_id_ = 0;
   int64_t done_count_ = 0;
   std::vector<std::unique_ptr<ExecQueue>> exec_queues_;

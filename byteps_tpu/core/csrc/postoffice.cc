@@ -35,6 +35,18 @@ bool RetryEnabled() {
   return on;
 }
 
+// Hot server replacement (ISSUE 4): how long the scheduler holds the
+// fleet in RECOVERY waiting for a replacement server before falling back
+// to the fail-stop broadcast. 0 disables recovery wholesale. Requires
+// the retry layer: the re-seed protocol rides the resend queue, and a
+// worker with retries off fails the dead rank's requests immediately.
+int64_t RecoveryTimeoutMs() {
+  static const int64_t ms = EnvLong("BYTEPS_RECOVERY_TIMEOUT_MS", 60000);
+  return ms;
+}
+
+bool RecoveryEnabled() { return RecoveryTimeoutMs() > 0 && RetryEnabled(); }
+
 int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
                       int num_workers, int num_servers,
                       AppHandler app_handler) {
@@ -81,6 +93,50 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
         RetryEnabled() && TryReconnect(node_id, stripe)) {
       BPS_METRIC_COUNTER_ADD("bps_reconnects_total", 1);
       if (peer_reconnected_cb_) peer_reconnected_cb_(node_id);
+      return;
+    }
+    // Persistent SERVER loss with hot replacement armed: do not fail the
+    // rank's in-flight requests — park them (retry clocks frozen via the
+    // paused callback) and wait for the scheduler's CMD_EPOCH_RESUME
+    // with the replacement's address, or the failure-SHUTDOWN fallback
+    // when no replacement arrives within BYTEPS_RECOVERY_TIMEOUT_MS.
+    // Worker deaths and scheduler loss keep the PR 3 fail-stop. The
+    // park is PROVISIONAL until the scheduler confirms the death
+    // (CMD_EPOCH_PAUSE): the server may be alive with only our
+    // connection broken, in which case no recovery will ever start —
+    // HeartbeatLoop keeps re-dialing and owns the escalation deadline
+    // (which also means recovery needs heartbeats: with them disabled
+    // nothing could ever detect the death or end the park).
+    if (role_ == ROLE_WORKER && node_id != kSchedulerId &&
+        node_id <= num_servers_ && RecoveryEnabled() &&
+        EnvSeconds("PS_HEARTBEAT_INTERVAL", 5.0) > 0) {
+      bool first;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        first = recovering_peers_.insert(node_id).second;
+        recovering_count_.store(
+            static_cast<int>(recovering_peers_.size()));
+        auto& dp = disc_parked_[node_id];
+        dp.stripes.insert(stripe);
+        if (dp.deadline_ms == 0) {
+          // Worst honest case: the death happened just after the last
+          // heartbeat the scheduler saw, then the full replacement
+          // window runs out — only past that can "no EPOCH_PAUSE" mean
+          // the scheduler will never act.
+          dp.deadline_ms =
+              NowMs() +
+              static_cast<int64_t>(
+                  EnvSeconds("PS_HEARTBEAT_TIMEOUT", 30.0) * 1000) +
+              RecoveryTimeoutMs() + 2000;
+        }
+      }
+      BPS_METRIC_GAUGE_SET("bps_recovering", 1);
+      if (first) {
+        BPS_LOG(WARNING) << "node " << my_id_ << ": server " << node_id
+                         << " unreachable — parking its in-flight "
+                            "requests, awaiting hot replacement";
+      }
+      if (peer_paused_cb_) peer_paused_cb_(node_id);
       return;
     }
     if (peer_lost_cb_) peer_lost_cb_(node_id);
@@ -147,6 +203,17 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
     const char* wid = getenv("DMLC_WORKER_ID");
     h.arg0 = wid && *wid ? atol(wid) : -1;  // preferred rank (deterministic)
     h.arg1 = role;
+    // Replacement server (ISSUE 4): DMLC_RECOVER_RANK=<server index>
+    // marks this registration as adopting a dead rank's id and shard —
+    // the scheduler answers with a direct ADDRBOOK instead of waiting
+    // for fleet formation (which already happened).
+    const char* rr = getenv("DMLC_RECOVER_RANK");
+    if (role == ROLE_SERVER && rr && *rr) {
+      h.arg0 = atol(rr);
+      h.version = 1;  // recovery-registration marker
+      BPS_LOG(WARNING) << "server: registering as hot replacement for "
+                          "server rank " << h.arg0;
+    }
     van_->Send(fd, h, &me, sizeof(me));
     // Wait for the address book (same formation bound as the scheduler).
     std::unique_lock<std::mutex> lk(mu_);
@@ -190,36 +257,52 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
   }
   if (role == ROLE_SCHEDULER && interval > 0) {
     // Failure detection (reference: ps-lite heartbeat timeout, SURVEY.md
-    // §5): a node missing heartbeats past PS_HEARTBEAT_TIMEOUT takes the
-    // fleet down fail-stop — the cluster manager owns the restart.
+    // §5): a node missing heartbeats past PS_HEARTBEAT_TIMEOUT. A dead
+    // SERVER with recovery armed enters scheduler-coordinated hot
+    // replacement (ISSUE 4); anything else — a dead worker, multiple
+    // simultaneous deaths, recovery disabled, or a replacement that
+    // never arrives — takes the fleet down fail-stop as before, and the
+    // cluster manager owns the restart.
+    Metrics::Get().Counter("bps_recoveries_total");
+    Metrics::Get().Gauge("bps_membership_epoch");
+    Metrics::Get().Gauge("bps_recovering");
     monitor_thread_ = std::thread([this, interval] {
+      int64_t next_check_ms =
+          NowMs() + static_cast<int64_t>(interval * 1000);
       while (!shutting_down_.load()) {
-        for (int i = 0; i < static_cast<int>(interval * 10) &&
-                        !shutting_down_.load();
-             ++i) {
-          usleep(100 * 1000);
-        }
+        usleep(100 * 1000);
         if (shutting_down_.load()) return;
-        auto dead = DeadNodes();
-        if (!dead.empty()) {
-          std::string ids;
-          for (int id : dead) ids += std::to_string(id) + " ";
-          BPS_LOG(WARNING) << "scheduler: node(s) " << ids
-                           << "missed heartbeats — broadcasting shutdown";
-          MsgHeader h{};
-          h.cmd = CMD_SHUTDOWN;
-          h.sender = kSchedulerId;
-          h.arg0 = 1;  // failure-triggered
+        {
+          // Recovery fallback deadline: checked every tick so the
+          // fail-stop is prompt even with long heartbeat intervals.
           std::lock_guard<std::mutex> lk(mu_);
-          for (const auto& n : nodes_) {
-            if (n.id == kSchedulerId) continue;
-            auto it = node_fd_.find(n.id);
-            if (it != node_fd_.end()) van_->Send(it->second, h);
+          if (recovering_node_ >= 0 && NowMs() > recovery_deadline_ms_) {
+            BroadcastFailureLocked(
+                "no replacement for server " +
+                std::to_string(recovering_node_) + " within " +
+                std::to_string(RecoveryTimeoutMs()) + " ms");
+            return;
           }
-          shutting_down_.store(true);
-          cv_.notify_all();
-          return;
         }
+        if (NowMs() < next_check_ms) continue;
+        next_check_ms = NowMs() + static_cast<int64_t>(interval * 1000);
+        auto dead = DeadNodes();
+        if (dead.empty()) continue;
+        // Recoverable: exactly one dead node, it is a server, and hot
+        // replacement is armed. (Simultaneous multi-server death is out
+        // of recovery scope — fail-stop, restart from checkpoint.)
+        bool recoverable = RecoveryEnabled() && dead.size() == 1 &&
+                           dead[0] >= ServerId(0) &&
+                           dead[0] <= num_servers_;
+        std::lock_guard<std::mutex> lk(mu_);
+        if (recoverable) {
+          if (recovering_node_ < 0) StartRecoveryLocked(dead[0]);
+          continue;
+        }
+        std::string ids;
+        for (int id : dead) ids += std::to_string(id) + " ";
+        BroadcastFailureLocked("node(s) " + ids + "missed heartbeats");
+        return;
       }
     });
   }
@@ -230,6 +313,14 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
 void Postoffice::ControlHandler(Message&& msg, int fd) {
   switch (msg.head.cmd) {
     case CMD_REGISTER: {
+      if (role_ == ROLE_SCHEDULER && msg.head.version == 1) {
+        // A replacement server adopting a dead rank (DMLC_RECOVER_RANK).
+        BPS_CHECK_EQ(msg.payload.size(), sizeof(NodeInfo));
+        NodeInfo info{};
+        memcpy(&info, msg.payload.data(), sizeof(NodeInfo));
+        HandleRecoverRegister(fd, info, static_cast<int>(msg.head.arg0));
+        break;
+      }
       if (role_ == ROLE_SCHEDULER) {
         std::unique_lock<std::mutex> lk(mu_);
         BPS_CHECK_EQ(msg.payload.size(), sizeof(NodeInfo));
@@ -332,6 +423,77 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
       // the fleet shutdown; re-inserting it would later read as a death.
       if (!departed_.count(msg.head.sender)) {
         last_heartbeat_ms_[msg.head.sender] = NowMs();
+      }
+      break;
+    }
+    case CMD_EPOCH_PAUSE: {
+      // A server rank died; the fleet entered RECOVERY at a new
+      // membership epoch. Workers freeze the rank's retry clocks (its
+      // in-flight requests stay parked in the resend queue) and keep
+      // training quiesced — the synchronous step is already blocked on
+      // the dead shard's handles.
+      int node = static_cast<int>(msg.head.arg1);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        epoch_.store(msg.head.arg0);
+        recovering_peers_.insert(node);
+        recovering_count_.store(
+            static_cast<int>(recovering_peers_.size()));
+        // Death confirmed: the scheduler owns escalation from here (its
+        // recovery deadline falls back to the failure SHUTDOWN), so the
+        // provisional disconnect-park probe/deadline stands down.
+        disc_parked_.erase(node);
+      }
+      BPS_METRIC_GAUGE_SET("bps_membership_epoch", epoch_.load());
+      BPS_METRIC_GAUGE_SET("bps_recovering", 1);
+      BPS_LOG(WARNING) << "node " << my_id_ << ": epoch "
+                       << msg.head.arg0 << " PAUSE — server " << node
+                       << " is being replaced";
+      if (role_ == ROLE_WORKER && peer_paused_cb_) peer_paused_cb_(node);
+      break;
+    }
+    case CMD_EPOCH_RESUME: {
+      // A replacement adopted the dead rank. Update the address book,
+      // redial (workers), then let the KV layer re-seed the shard and
+      // drain the parked resend queue.
+      int node = static_cast<int>(msg.head.arg1);
+      BPS_CHECK_EQ(msg.payload.size(), sizeof(NodeInfo));
+      NodeInfo info{};
+      memcpy(&info, msg.payload.data(), sizeof(NodeInfo));
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        epoch_.store(msg.head.arg0);
+        for (auto& n : nodes_) {
+          if (n.id == node) n = info;
+        }
+      }
+      BPS_METRIC_GAUGE_SET("bps_membership_epoch", epoch_.load());
+      bool dialed = true;
+      if (role_ == ROLE_WORKER) dialed = DialReplacement(node, info);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        recovering_peers_.erase(node);
+        recovering_count_.store(
+            static_cast<int>(recovering_peers_.size()));
+        disc_parked_.erase(node);
+      }
+      if (role_ != ROLE_WORKER) {
+        // Workers clear the flag once the re-seed completes
+        // (BytePSWorker::RecoverServer); other roles are done here.
+        BPS_METRIC_GAUGE_SET("bps_recovering", 0);
+      }
+      BPS_LOG(WARNING) << "node " << my_id_ << ": epoch "
+                       << msg.head.arg0 << " RESUME — server " << node
+                       << " replaced at " << info.host << ":"
+                       << info.port;
+      if (role_ == ROLE_WORKER) {
+        if (dialed && peer_recovered_cb_) {
+          peer_recovered_cb_(node);
+        } else if (!dialed && peer_lost_cb_) {
+          // The replacement died before we could reach it: escalate to
+          // the pre-recovery fail-fast for this rank's requests.
+          peer_lost_cb_(node);
+        }
       }
       break;
     }
@@ -482,6 +644,157 @@ bool Postoffice::TryReconnect(int node_id, int stripe) {
   return false;
 }
 
+void Postoffice::BroadcastFailureLocked(const std::string& why) {
+  BPS_LOG(WARNING) << "scheduler: " << why
+                   << " — broadcasting failure shutdown";
+  MsgHeader h{};
+  h.cmd = CMD_SHUTDOWN;
+  h.sender = kSchedulerId;
+  h.arg0 = 1;  // failure-triggered
+  for (const auto& n : nodes_) {
+    if (n.id == kSchedulerId) continue;
+    auto it = node_fd_.find(n.id);
+    if (it != node_fd_.end()) van_->Send(it->second, h);
+  }
+  shutting_down_.store(true);
+  cv_.notify_all();
+}
+
+void Postoffice::StartRecoveryLocked(int node_id) {
+  epoch_.fetch_add(1);
+  recovering_node_ = node_id;
+  recovery_deadline_ms_ = NowMs() + RecoveryTimeoutMs();
+  recovering_peers_.insert(node_id);
+  recovering_count_.store(static_cast<int>(recovering_peers_.size()));
+  // Stop re-detecting the dead rank: it is no longer "dead", it is
+  // "being replaced". Heartbeat tracking resumes with the replacement.
+  last_heartbeat_ms_.erase(node_id);
+  BPS_METRIC_GAUGE_SET("bps_membership_epoch", epoch_.load());
+  BPS_METRIC_GAUGE_SET("bps_recovering", 1);
+  BPS_LOG(WARNING) << "scheduler: server " << node_id
+                   << " missed heartbeats — epoch " << epoch_.load()
+                   << " RECOVERY (waiting up to " << RecoveryTimeoutMs()
+                   << " ms for a replacement with DMLC_RECOVER_RANK="
+                   << node_id - ServerId(0) << ")";
+  MsgHeader h{};
+  h.cmd = CMD_EPOCH_PAUSE;
+  h.sender = kSchedulerId;
+  h.arg0 = epoch_.load();
+  h.arg1 = node_id;
+  for (const auto& n : nodes_) {
+    if (n.id == kSchedulerId || n.id == node_id) continue;
+    auto it = node_fd_.find(n.id);
+    if (it != node_fd_.end()) van_->Send(it->second, h);
+  }
+}
+
+void Postoffice::HandleRecoverRegister(int fd, const NodeInfo& info,
+                                       int rank) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!addrbook_ready_) {
+    BPS_LOG(WARNING) << "scheduler: recovery registration for server "
+                        "rank " << rank
+                     << " before fleet formation — ignored";
+    return;
+  }
+  if (rank < 0 || rank >= num_servers_) {
+    BPS_LOG(WARNING) << "scheduler: recovery registration with "
+                        "out-of-range DMLC_RECOVER_RANK=" << rank
+                     << " (fleet has " << num_servers_
+                     << " servers) — ignored";
+    return;
+  }
+  int id = ServerId(rank);
+  if (recovering_node_ >= 0 && recovering_node_ != id) {
+    BPS_LOG(WARNING) << "scheduler: replacement registered for server "
+                     << id << " but node " << recovering_node_
+                     << " is the one under recovery — ignored";
+    return;
+  }
+  if (recovering_node_ < 0) {
+    // The supervisor respawned the server BEFORE the heartbeat monitor
+    // declared it dead (the common fast path). Open the recovery window
+    // now; the PAUSE and the RESUME below arrive back-to-back, in
+    // order, on each node's scheduler connection.
+    BPS_LOG(WARNING) << "scheduler: replacement for server " << id
+                     << " registered ahead of dead-node detection — "
+                        "starting recovery inline";
+    StartRecoveryLocked(id);
+  }
+  NodeInfo adopted = info;
+  adopted.id = id;
+  adopted.role = ROLE_SERVER;
+  for (auto& n : nodes_) {
+    if (n.id == id) n = adopted;
+  }
+  node_fd_[id] = fd;
+  last_heartbeat_ms_[id] = NowMs();
+  recovering_node_ = -1;
+  recovery_deadline_ms_ = 0;
+  recovering_peers_.erase(id);
+  recovering_count_.store(static_cast<int>(recovering_peers_.size()));
+  BPS_METRIC_GAUGE_SET("bps_recovering", 0);
+  BPS_METRIC_COUNTER_ADD("bps_recoveries_total", 1);
+  // The replacement gets its id + the current address book directly
+  // (fleet formation already happened; it must not wait for one).
+  MsgHeader ab{};
+  ab.cmd = CMD_ADDRBOOK;
+  ab.sender = kSchedulerId;
+  ab.arg0 = id;
+  van_->Send(fd, ab, nodes_.data(), nodes_.size() * sizeof(NodeInfo));
+  // Resume the fleet: every node updates its book and workers redial,
+  // re-seed the shard, and drain their parked resend queues.
+  MsgHeader rs{};
+  rs.cmd = CMD_EPOCH_RESUME;
+  rs.sender = kSchedulerId;
+  rs.arg0 = epoch_.load();
+  rs.arg1 = id;
+  for (const auto& n : nodes_) {
+    if (n.id == kSchedulerId || n.id == id) continue;
+    auto it = node_fd_.find(n.id);
+    if (it != node_fd_.end()) {
+      van_->Send(it->second, rs, &adopted, sizeof(adopted));
+    }
+  }
+  BPS_LOG(WARNING) << "scheduler: server " << id << " hot-replaced at "
+                   << adopted.host << ":" << adopted.port << " (epoch "
+                   << epoch_.load() << ")";
+}
+
+bool Postoffice::DialReplacement(int node_id, const NodeInfo& info) {
+  int streams = 1;
+  if (const char* sv = getenv("BYTEPS_VAN_STREAMS")) {
+    streams = atoi(sv);
+    if (streams < 1) streams = 1;
+  }
+  std::vector<int> fds;
+  for (int s = 0; s < streams; ++s) {
+    // The replacement is already registered with the scheduler, so its
+    // listener is up: a handful of dial attempts is plenty.
+    int fd = van_->Connect(info.host, info.port, 50);
+    if (fd < 0) {
+      BPS_LOG(WARNING) << "node " << my_id_
+                       << ": cannot reach replacement server " << node_id
+                       << " at " << info.host << ":" << info.port;
+      return false;
+    }
+    MsgHeader hello{};
+    hello.cmd = CMD_REGISTER;
+    hello.sender = my_id_;
+    hello.arg1 = role_;
+    if (!van_->Send(fd, hello)) return false;
+    fds.push_back(fd);
+  }
+  std::lock_guard<std::mutex> lk(mu_);
+  node_fd_[node_id] = fds[0];
+  if (fds.size() > 1) {
+    node_extra_fds_[node_id].assign(fds.begin() + 1, fds.end());
+  } else {
+    node_extra_fds_.erase(node_id);
+  }
+  return true;
+}
+
 void Postoffice::HeartbeatLoop() {
   double interval = EnvSeconds("PS_HEARTBEAT_INTERVAL", 5.0);
   while (!shutting_down_.load() && !van_->stopped()) {
@@ -513,6 +826,70 @@ void Postoffice::HeartbeatLoop() {
         if (shutdown_cb_) shutdown_cb_();
       }
       break;
+    }
+    // Disconnect-parked ranks (recovery armed, death NOT yet confirmed
+    // by an EPOCH_PAUSE): keep probing — if the peer is alive and only
+    // our connection broke, re-dial and resume (the scheduler would
+    // never have started a recovery for it). Past the deadline the
+    // scheduler has had the full detect+replace window and stayed
+    // silent: escalate to the pre-recovery fail-fast so the fleet
+    // cannot wedge on a park nobody owns.
+    std::vector<std::pair<int, DiscPark>> parked;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (const auto& kv : disc_parked_) parked.push_back(kv);
+    }
+    for (auto& pk : parked) {
+      const int node = pk.first;
+      bool redialed = true;
+      for (int s : pk.second.stripes) {
+        if (!TryReconnect(node, s)) { redialed = false; break; }
+      }
+      if (redialed) {
+        bool still_parked;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          // An EPOCH_PAUSE/RESUME may have raced the re-dial; the
+          // scheduler owns the rank then — drop our claim quietly.
+          still_parked = disc_parked_.erase(node) > 0;
+          if (still_parked) {
+            recovering_peers_.erase(node);
+            recovering_count_.store(
+                static_cast<int>(recovering_peers_.size()));
+            if (recovering_peers_.empty()) {
+              BPS_METRIC_GAUGE_SET("bps_recovering", 0);
+            }
+          }
+        }
+        if (still_parked) {
+          BPS_METRIC_COUNTER_ADD("bps_reconnects_total", 1);
+          BPS_LOG(WARNING)
+              << "node " << my_id_ << ": parked server " << node
+              << " was alive all along — reconnected, resuming";
+          if (peer_reconnected_cb_) peer_reconnected_cb_(node);
+        }
+      } else if (NowMs() > pk.second.deadline_ms) {
+        bool still_parked;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          still_parked = disc_parked_.erase(node) > 0;
+          if (still_parked) {
+            recovering_peers_.erase(node);
+            recovering_count_.store(
+                static_cast<int>(recovering_peers_.size()));
+            if (recovering_peers_.empty()) {
+              BPS_METRIC_GAUGE_SET("bps_recovering", 0);
+            }
+          }
+        }
+        if (still_parked) {
+          BPS_LOG(WARNING)
+              << "node " << my_id_ << ": server " << node
+              << " unreachable and the scheduler never opened a "
+                 "recovery for it — escalating to fail-fast";
+          if (peer_lost_cb_) peer_lost_cb_(node);
+        }
+      }
     }
     for (int i = 0; i < static_cast<int>(interval * 10) &&
                     !shutting_down_.load();

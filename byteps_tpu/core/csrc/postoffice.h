@@ -12,6 +12,7 @@
 #include <functional>
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <unordered_map>
@@ -76,6 +77,24 @@ class Postoffice {
     peer_reconnected_cb_ = std::move(cb);
   }
 
+  // Hot server replacement (ISSUE 4). Paused: a server rank is presumed
+  // dead and under scheduler-coordinated recovery — the KV layer freezes
+  // that rank's retry clocks (requests park in the resend queue instead
+  // of escalating). Recovered: a replacement adopted the rank and this
+  // worker's connection was redialled — the worker re-seeds the shard
+  // and drains the parked queue. Both run on van recv threads.
+  void SetPeerPausedCallback(std::function<void(int node_id)> cb) {
+    peer_paused_cb_ = std::move(cb);
+  }
+  void SetPeerRecoveredCallback(std::function<void(int node_id)> cb) {
+    peer_recovered_cb_ = std::move(cb);
+  }
+
+  // Current membership epoch (bumped by the scheduler per recovery) and
+  // whether any rank is mid-recovery from this node's point of view.
+  int64_t epoch() const { return epoch_.load(); }
+  bool Recovering() const { return recovering_count_.load() > 0; }
+
   // True once this node received (or itself triggered) a FAILURE
   // shutdown — the scheduler's dead-node broadcast (CMD_SHUTDOWN
   // arg0=1) or a lost scheduler connection — as opposed to the clean
@@ -112,6 +131,22 @@ class Postoffice {
  private:
   void ControlHandler(Message&& msg, int fd);
   void HeartbeatLoop();
+  // Scheduler: enter RECOVERY for a dead server rank — bump the epoch,
+  // broadcast CMD_EPOCH_PAUSE, and arm the replacement-wait deadline.
+  // Caller holds mu_.
+  void StartRecoveryLocked(int node_id);
+  // Scheduler: a replacement registered for `rank` (CMD_REGISTER with
+  // the recovery marker) — adopt it: assign the dead rank's id, update
+  // the address book, reply ADDRBOOK, broadcast CMD_EPOCH_RESUME.
+  void HandleRecoverRegister(int fd, const NodeInfo& info, int rank);
+  // Scheduler: the fail-stop broadcast (failure SHUTDOWN, arg0=1) —
+  // shared by the heartbeat monitor and the recovery-timeout fallback.
+  // Caller holds mu_.
+  void BroadcastFailureLocked(const std::string& why);
+  // Worker: dial the replacement server (all stripes), re-identify, and
+  // swap the rank's fds. Returns false when the replacement is already
+  // unreachable (escalates to peer-lost).
+  bool DialReplacement(int node_id, const NodeInfo& info);
   // Re-dial a lost worker->server connection (stripe `stripe`; 0 =
   // primary) with capped exponential backoff (BYTEPS_RECONNECT_MAX /
   // BYTEPS_RECONNECT_BACKOFF_MS). On success the fresh fd replaces the
@@ -155,6 +190,31 @@ class Postoffice {
   std::function<void()> shutdown_cb_;
   std::function<void(int)> peer_lost_cb_;
   std::function<void(int)> peer_reconnected_cb_;
+  std::function<void(int)> peer_paused_cb_;
+  std::function<void(int)> peer_recovered_cb_;
+
+  // Hot-server-replacement state (guarded by mu_ unless atomic).
+  std::atomic<int64_t> epoch_{0};          // fleet membership epoch
+  std::atomic<int> recovering_count_{0};   // ranks currently mid-recovery
+  std::unordered_set<int> recovering_peers_;  // node ids under recovery
+  // Worker only: ranks parked by a LOCAL disconnect whose death the
+  // scheduler has NOT yet confirmed (no CMD_EPOCH_PAUSE seen). The peer
+  // may well be alive with only our connection broken (asymmetric loss,
+  // chaos resets exhausting the reconnect ladder under load), and the
+  // scheduler will then never start a recovery — so HeartbeatLoop keeps
+  // re-dialing these (resume on success) and escalates to the
+  // pre-recovery fail-fast once the deadline passes: by then a genuine
+  // death would have produced either an EPOCH_RESUME or the scheduler's
+  // no-replacement failure SHUTDOWN. stripes = dead stripes to re-dial.
+  struct DiscPark {
+    std::set<int> stripes;
+    int64_t deadline_ms = 0;
+  };
+  std::unordered_map<int, DiscPark> disc_parked_;
+  // scheduler only: the rank being replaced (-1 = none) and the
+  // fall-back-to-fail-stop deadline for the replacement to arrive.
+  int recovering_node_ = -1;
+  int64_t recovery_deadline_ms_ = 0;
 };
 
 int64_t NowMs();
@@ -163,5 +223,12 @@ int64_t NowMs();
 // switch shared by the van reconnect path (postoffice.cc) and the KV
 // retry layer (kv.h). 0 = pre-retry fail-fast behavior.
 bool RetryEnabled();
+
+// Hot server replacement master switch: BYTEPS_RECOVERY_TIMEOUT_MS > 0
+// (default 60000) AND the retry layer on (re-seed rides the resend
+// queue). 0 restores the PR 3 behavior wholesale: a dead server is a
+// fleet-wide failure SHUTDOWN.
+bool RecoveryEnabled();
+int64_t RecoveryTimeoutMs();
 
 }  // namespace bps

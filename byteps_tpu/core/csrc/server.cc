@@ -14,6 +14,13 @@ namespace bps {
 void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
   po_ = po;
   async_ = async_mode;
+  const char* rr = getenv("DMLC_RECOVER_RANK");
+  recover_mode_ = rr && *rr;
+  if (recover_mode_) {
+    BPS_LOG(WARNING) << "server: starting as hot replacement (rank "
+                     << rr << ") — re-seed state: unknown-key data ops "
+                        "park until their INIT_KEY re-declare arrives";
+  }
   // Pre-register the server-side metric catalog so every /metrics page
   // serves the full series from zero — an idle server (no key routed to
   // it yet) must still expose bps_recv_bytes_total for the fleet-wide
@@ -319,11 +326,22 @@ void BytePSServer::Process(EngineTask&& task) {
   Message& msg = task.msg;
   const MsgHeader& h = msg.head;
   const int fd = task.fd;
+  // Re-seed state (recovery incarnation): in-flight data ops redirected
+  // from the dead predecessor may beat the worker's INIT_KEY
+  // re-declares here. Park them (keepalive keeps the sender patient)
+  // and replay them once the key exists — fresh normal servers keep the
+  // unknown-key fatal, it is a protocol violation there.
+  if (recover_mode_ &&
+      (h.cmd == CMD_PUSH || h.cmd == CMD_PULL || h.cmd == CMD_BCAST_PUSH ||
+       h.cmd == CMD_BCAST_PULL || h.cmd == CMD_RESEED) &&
+      GetStore(h.key) == nullptr) {
+    if (ParkUndeclared(std::move(task))) return;
+  }
   // Dedup window (see KeyStore::SenderRec): applies to the per-key
   // stateful commands. INIT_KEY is naturally idempotent and skips it.
   if (RetryEnabled() && !task.from_park &&
       (h.cmd == CMD_PUSH || h.cmd == CMD_PULL || h.cmd == CMD_BCAST_PUSH ||
-       h.cmd == CMD_BCAST_PULL)) {
+       h.cmd == CMD_BCAST_PULL || h.cmd == CMD_RESEED)) {
     KeyStore* ks = GetStore(h.key);
     if (ks) {
       auto& rec = ks->seen[h.sender];
@@ -375,6 +393,20 @@ void BytePSServer::Process(EngineTask&& task) {
       ack.key = h.key;
       ack.req_id = h.req_id;
       po_->van().Send(fd, ack);
+      // Recovery incarnation: data ops that arrived before this
+      // re-declare were parked; the key exists now — replay them (on
+      // this same engine thread, so per-key ordering holds; replays go
+      // through the dedup window like first arrivals, which they are).
+      std::vector<EngineTask> parked;
+      {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        auto it = pre_declare_parked_.find(h.key);
+        if (it != pre_declare_parked_.end()) {
+          parked = std::move(it->second);
+          pre_declare_parked_.erase(it);
+        }
+      }
+      for (auto& t : parked) Process(std::move(t));
       break;
     }
 
@@ -383,6 +415,24 @@ void BytePSServer::Process(EngineTask&& task) {
       BPS_CHECK(ks) << "push for undeclared key " << h.key;
       const bool is_async = async_ || (h.flags & FLAG_ASYNC);
       if (!is_async) {
+        int stale_slot = h.version & 1;
+        if (RetryEnabled() && ks->last_round[stale_slot] >= h.version) {
+          // A push for a round that already COMPLETED (every worker's
+          // contribution summed, all pulls served or re-servable from
+          // the retained slot). Unreachable in normal operation — a
+          // wire duplicate is caught by the dedup window above — but a
+          // recovery RE-PUSH (its contribution was inside a re-seeded
+          // aggregate) arrives with a fresh req_id and lands here:
+          // ack it, never re-apply.
+          MsgHeader ack{};
+          ack.cmd = CMD_PUSH_ACK;
+          ack.sender = po_->my_id();
+          ack.key = h.key;
+          ack.req_id = h.req_id;
+          MarkReplied(ks, h.sender, h.req_id, ack);
+          SendReply(task, ack);
+          break;
+        }
         // A push for round r+2 can land while its slot still accumulates
         // or serves round r (3+ rounds of one tensor in flight). Park the
         // raw message; replayed — and only then acked, which is the
@@ -522,10 +572,64 @@ void BytePSServer::Process(EngineTask&& task) {
         int slot = h.version & 1;
         if (ks->ready[slot] && ks->round[slot] == h.version) {
           if (ReplyPull(ks, slot, task)) ReplayParked(ks, slot);
+        } else if (RetryEnabled() && ks->last_round[slot] == h.version) {
+          // Pull for a COMPLETED round arriving with a fresh req_id:
+          // only reachable post-recovery (a parked pull redirected to
+          // the replacement after the round's aggregate was re-seeded,
+          // or re-delivered while the retained replay window still
+          // holds it). Serve the retained data; the round's pull
+          // accounting is final, so do not advance pull_count.
+          ServeRetainedPull(ks, slot, task);
         } else {
           ks->pending_pulls[slot].push_back(std::move(task));
         }
       }
+      break;
+    }
+
+    case CMD_RESEED: {
+      // Hot-replacement re-seed (ISSUE 4): a worker that COMPLETED
+      // round `version` for this key re-pushes the round's unscaled
+      // aggregate so pulls parked mid-round on the dead predecessor can
+      // be served bit-identically. Highest round offered wins; all
+      // offers for one round carry identical bytes (they are the same
+      // completed sum), so replays and multi-worker offers are
+      // idempotent.
+      KeyStore* ks = GetStore(h.key);
+      BPS_CHECK(ks) << "reseed for undeclared key " << h.key;
+      int slot = h.version & 1;
+      if (static_cast<int>(h.version) > ks->last_round[slot]) {
+        ks->slot[slot].assign(msg.payload.begin(), msg.payload.end());
+        ks->last_round[slot] = h.version;
+        // The slot may already be accumulating this round from
+        // recovery re-pushes that arrived first; the reseed IS that
+        // round's final sum — supersede the partial accumulation.
+        if (ks->round[slot] == static_cast<int>(h.version)) {
+          ks->round[slot] = -1;
+          ks->push_count[slot] = 0;
+          ks->pull_count[slot] = 0;
+          ks->ready[slot] = false;
+        }
+        ks->comp_reply[slot].clear();
+        // Pulls for this round parked before the reseed landed are
+        // servable now.
+        std::vector<EngineTask> waiting;
+        waiting.swap(ks->pending_pulls[slot]);
+        for (auto& p : waiting) {
+          if (p.msg.head.version == static_cast<int>(h.version)) {
+            ServeRetainedPull(ks, slot, p);
+          } else {
+            ks->pending_pulls[slot].push_back(std::move(p));
+          }
+        }
+      }
+      MsgHeader ack{};
+      ack.cmd = CMD_PUSH_ACK;
+      ack.sender = po_->my_id();
+      ack.key = h.key;
+      ack.req_id = h.req_id;
+      MarkReplied(ks, h.sender, h.req_id, ack);
+      SendReply(task, ack);
       break;
     }
 
@@ -589,6 +693,47 @@ void BytePSServer::Process(EngineTask&& task) {
 
     default:
       BPS_LOG(WARNING) << "server: unexpected cmd " << h.cmd;
+  }
+}
+
+bool BytePSServer::ParkUndeclared(EngineTask&& task) {
+  // Keepalive first (task is moved below): the sender's retry budget
+  // stays fresh while its re-declare is still in flight.
+  SendKeepalive(task);
+  BPS_LOG(WARNING) << "server: parking " << task.msg.head.cmd
+                   << " for not-yet-redeclared key " << task.msg.head.key
+                   << " (re-seed in progress)";
+  std::lock_guard<std::mutex> lk(store_mu_);
+  pre_declare_parked_[task.msg.head.key].push_back(std::move(task));
+  return true;
+}
+
+void BytePSServer::ServeRetainedPull(KeyStore* ks, int slot,
+                                     const EngineTask& t) {
+  const MsgHeader& req = t.msg.head;
+  MsgHeader resp{};
+  resp.cmd = CMD_PULL_RESP;
+  resp.sender = po_->my_id();
+  resp.key = req.key;
+  resp.req_id = req.req_id;
+  resp.dtype = ks->dtype;
+  resp.version = req.version;
+  if (ks->reply_comp && !ks->comp_reply[slot].empty()) {
+    // Normal-operation replay window: the cached encode is still valid
+    // for this round. (A re-seeded slot clears it and serves raw.)
+    resp.flags = FLAG_COMPRESSED;
+    resp.arg0 = ks->len;
+    BPS_METRIC_COUNTER_ADD(
+        "bps_server_reply_bytes_total",
+        static_cast<int64_t>(ks->comp_reply[slot].size()));
+    MarkReplied(ks, req.sender, req.req_id, resp);
+    SendReply(t, resp, ks->comp_reply[slot].data(),
+              ks->comp_reply[slot].size());
+  } else {
+    BPS_METRIC_COUNTER_ADD("bps_server_reply_bytes_total",
+                           static_cast<int64_t>(ks->slot[slot].size()));
+    MarkReplied(ks, req.sender, req.req_id, resp);
+    SendReply(t, resp, ks->slot[slot].data(), ks->slot[slot].size());
   }
 }
 

@@ -191,6 +191,15 @@ class BytePSServer {
   // Returns true when this pull completed the round and recycled the
   // slot (caller must then ReplayParked).
   bool ReplyPull(KeyStore* ks, int slot, const EngineTask& t);
+  // Serve a pull for an already-COMPLETED round from the retained slot
+  // data (the replay window / a re-seeded aggregate) without advancing
+  // pull_count — the round's accounting is final; this is re-delivery.
+  void ServeRetainedPull(KeyStore* ks, int slot, const EngineTask& t);
+  // Recovery incarnation only: a data-plane op for a key that has not
+  // been re-declared yet parks here (keepalive keeps the worker's retry
+  // budget fresh) and replays when its INIT_KEY arrives. Returns true
+  // when the task was parked.
+  bool ParkUndeclared(EngineTask&& task);
   void ReplayParked(KeyStore* ks, int slot);
   void ReplyBcastPull(KeyStore* ks, int fd, const MsgHeader& req);
   void ServeBcastRound(KeyStore* ks, int round, int fd,
@@ -198,8 +207,13 @@ class BytePSServer {
 
   Postoffice* po_ = nullptr;
   bool async_ = false;
-  std::mutex store_mu_;  // guards store_ map shape only
+  // Replacement incarnation (DMLC_RECOVER_RANK set): data-plane ops may
+  // legally arrive before their keys are re-declared — park them
+  // instead of treating an unknown key as a protocol violation.
+  bool recover_mode_ = false;
+  std::mutex store_mu_;  // guards store_ map shape + pre_declare_parked_
   std::unordered_map<int64_t, std::unique_ptr<KeyStore>> store_;
+  std::unordered_map<int64_t, std::vector<EngineTask>> pre_declare_parked_;
 
   struct EngineQueue {
     std::mutex mu;

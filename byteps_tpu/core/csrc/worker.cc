@@ -67,6 +67,13 @@ void BytePSWorker::Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
   Metrics::Get().Counter("bps_reconnects_total");
   Metrics::Get().Counter("bps_seq_gaps_total");
   Metrics::Get().Counter("bps_seq_dups_total");
+  // Hot-replacement telemetry (docs/monitoring.md "Recovery"):
+  // recoveries this worker completed, the fleet membership epoch, and
+  // whether a rank is mid-recovery right now.
+  Metrics::Get().Counter("bps_recoveries_total");
+  Metrics::Get().Gauge("bps_membership_epoch");
+  Metrics::Get().Gauge("bps_recovering");
+  recovery_on_ = RecoveryEnabled();
   // Reference semantics: BYTEPS_SCHEDULING_CREDIT is an in-flight BYTE
   // budget. 0 = auto: four full partitions' worth. A value under 1024
   // can only be a legacy partition count (the reference default was 4;
@@ -125,6 +132,177 @@ void BytePSWorker::Stop() {
     if (t.joinable()) t.join();
   }
   push_threads_.clear();
+  std::vector<std::thread> rec;
+  {
+    std::lock_guard<std::mutex> lk(rec_threads_mu_);
+    rec.swap(rec_threads_);
+  }
+  for (auto& t : rec) {
+    if (t.joinable()) t.join();
+  }
+}
+
+// --- hot-replacement recovery bookkeeping (ISSUE 4) -------------------------
+
+void BytePSWorker::RecTrackPush(Part* p, const PushOp& op) {
+  if (!recovery_on_) return;
+  std::lock_guard<std::mutex> lk(rec_mu_);
+  p->rec_op = op;
+  p->rec_stage = 1;
+  p->rec_push_rid = -1;
+}
+
+void BytePSWorker::RecTrackPushRid(Part* p, int rid) {
+  if (!recovery_on_) return;
+  std::lock_guard<std::mutex> lk(rec_mu_);
+  // Only while still in push stage: a fast ack may have advanced (or a
+  // fast chain completed) the state before Request returned.
+  if (p->rec_stage == 1) p->rec_push_rid = rid;
+}
+
+void BytePSWorker::RecTrackAck(Part* p) {
+  if (!recovery_on_) return;
+  std::lock_guard<std::mutex> lk(rec_mu_);
+  p->rec_stage = 2;
+}
+
+void BytePSWorker::RecTrackDone(Part* p, int version, const char* base,
+                                int64_t raw_len) {
+  if (!recovery_on_) return;
+  std::lock_guard<std::mutex> lk(rec_mu_);
+  // Retain the round's UNSCALED aggregate (exactly the server's slot
+  // bytes): the authoritative re-seed payload should the owning server
+  // die while a peer's pull for this round is still outstanding.
+  p->reseed_data.assign(base, base + raw_len);
+  p->reseed_round = version;
+  p->rec_stage = 0;
+  p->rec_push_rid = -1;
+}
+
+void BytePSWorker::RecClear(Part* p) {
+  if (!recovery_on_) return;
+  std::lock_guard<std::mutex> lk(rec_mu_);
+  p->rec_stage = 0;
+  p->rec_push_rid = -1;
+}
+
+void BytePSWorker::OnServerRecovered(int node_id) {
+  // Off the van recv thread: the re-seed BLOCKS on INIT_KEY acks, and
+  // the scheduler connection's recv thread must stay free to deliver a
+  // failure SHUTDOWN should the replacement die mid-recovery.
+  std::lock_guard<std::mutex> lk(rec_threads_mu_);
+  rec_threads_.emplace_back([this, node_id] { RecoverServer(node_id); });
+}
+
+void BytePSWorker::RecoverServer(int node_id) {
+  // Snapshot this rank's shard (tensors_ only grows and Part addresses
+  // are stable after declare).
+  std::vector<std::pair<TensorCtx*, Part*>> mine;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& ctx : tensors_) {
+      for (auto& part : ctx->parts) {
+        if (part.server_id == node_id) {
+          mine.emplace_back(ctx.get(), &part);
+        }
+      }
+    }
+  }
+  BPS_LOG(WARNING) << "worker: re-seeding replacement server " << node_id
+                   << " (" << mine.size() << " partition(s))";
+  // 1. Decide each partition's recovery action BEFORE anything is
+  //    resent. Once the parked resend queue drains (step 2), a resent
+  //    push can settle against the replacement at any moment and
+  //    advance rec_stage to 2 — deciding from the live state after
+  //    that would RE-PUSH a contribution the replacement has already
+  //    applied under a different req_id, which the dedup window cannot
+  //    link: a double-applied push, silent corruption. The snapshot is
+  //    stable: the rank's requests are still paused, and only the dead
+  //    predecessor could settle them.
+  struct Action {
+    TensorCtx* ctx;
+    Part* p;
+    bool repush;  // false = reseed
+  };
+  std::vector<Action> actions;
+  for (auto& it : mine) {
+    Part* p = it.second;
+    std::lock_guard<std::mutex> lk(rec_mu_);
+    const bool push_settled =
+        p->rec_stage == 2 ||
+        (p->rec_stage == 1 && p->rec_push_rid >= 0 &&
+         !kv_->HasPending(p->rec_push_rid));
+    if (push_settled) {
+      // The dead server's partial sum held this contribution; the
+      // resend queue does not (the request settled). Re-push it.
+      actions.push_back({it.first, p, true});
+    } else if (p->rec_stage == 0 && p->reseed_round >= 0) {
+      // Idle key with a completed round retained: offer the aggregate
+      // so a peer's parked pull for that round can be served.
+      actions.push_back({it.first, p, false});
+    }
+    // rec_stage 1 with the push still pending: the resend queue owns
+    // re-delivery; nothing extra to do.
+  }
+  // 2. Lift the pause and drain the parked resend queue: the blocking
+  //    INIT_KEY wait below relies on the retry clock to re-deliver
+  //    declares the chaos layer (or a flaky link) eats, and a paused
+  //    rank's clock is frozen. Draining before the declares is safe —
+  //    the replacement PARKS data ops for not-yet-redeclared keys and
+  //    keepalives their senders (re-seed state, server.cc).
+  kv_->ResendNode(node_id);
+  // 3. Re-declare the shard — the replacement's store is empty, and a
+  //    payload for an undeclared key is a protocol violation once its
+  //    re-seed grace ends. Blocking, but only on our own INIT_KEYs.
+  std::vector<int> reqs;
+  for (auto& it : mine) {
+    TensorCtx* ctx = it.first;
+    Part* p = it.second;
+    MsgHeader h{};
+    h.cmd = CMD_INIT_KEY;
+    h.key = p->key;
+    h.dtype = ctx->dtype;
+    h.arg0 = p->len * DtypeSize(ctx->dtype);
+    reqs.push_back(kv_->Request(
+        node_id, h, ctx->comp_config.data(),
+        static_cast<int64_t>(ctx->comp_config.size()), nullptr));
+  }
+  kv_->WaitRequests(reqs);
+  // 4. Issue the snapshotted re-pushes and reseeds. Payload lifetimes
+  //    hold: a re-pushed op's handle has not settled (its pull cannot
+  //    complete before our contribution lands), so the caller buffer /
+  //    comp_buf are alive; reseed_data is worker-owned and only
+  //    overwritten after the key's NEXT round completes, which this
+  //    recovery gates. Ordinary retried requests from here — the timer
+  //    re-drives any the wire eats, the dedup window absorbs replays.
+  int repushed = 0, reseeded = 0;
+  for (const Action& a : actions) {
+    std::lock_guard<std::mutex> lk(rec_mu_);
+    MsgHeader h{};
+    h.key = a.p->key;
+    h.dtype = a.ctx->dtype;
+    if (a.repush) {
+      h.cmd = CMD_PUSH;
+      h.version = a.p->rec_op.version;
+      h.flags = a.p->rec_op.flags;
+      h.arg0 = a.p->rec_op.raw_len;
+      kv_->Request(node_id, h, a.p->rec_op.payload,
+                   a.p->rec_op.payload_len, nullptr);
+      ++repushed;
+    } else {
+      h.cmd = CMD_RESEED;
+      h.version = a.p->reseed_round;
+      kv_->Request(node_id, h, a.p->reseed_data.data(),
+                   static_cast<int64_t>(a.p->reseed_data.size()),
+                   nullptr);
+      ++reseeded;
+    }
+  }
+  BPS_METRIC_COUNTER_ADD("bps_recoveries_total", 1);
+  BPS_METRIC_GAUGE_SET("bps_recovering", 0);
+  BPS_LOG(WARNING) << "worker: server " << node_id << " re-seeded ("
+                   << repushed << " re-pushed, " << reseeded
+                   << " re-seeded round(s)) — resuming";
 }
 
 void BytePSWorker::PushLoop() {
@@ -250,6 +428,9 @@ int64_t BytePSWorker::Declare(const std::string& name, int64_t nelem,
     BPS_CHECK_EQ(dtype, BPS_FLOAT32)
         << "lossy compressors operate on float32 gradients";
   }
+  // Retained for the hot-replacement re-declare (RecoverServer): the
+  // replacement server must rebuild each key's codec exactly.
+  ctx->comp_config = comp;
 
   int esz = DtypeSize(dtype);
   int64_t per_part = std::max<int64_t>(1, partition_bytes_ / esz);
@@ -401,13 +582,15 @@ void BytePSWorker::SendPush(PushOp op) {
   // and server-side recv totals sum to the same number fleet-wide.
   BPS_METRIC_COUNTER_ADD("bps_push_bytes_total", op.payload_len);
   BPS_METRIC_COUNTER_ADD("bps_push_partitions_total", 1);
-  kv_->Request(
+  RecTrackPush(p, op);
+  int push_rid = kv_->Request(
       p->server_id, h, op.payload, op.payload_len,
       [this, ctx, p, base, raw_len, version, scale, flags, handle,
        t_push](Message&& ack) {
         if (ack.head.cmd == CMD_ERROR) {
           // Dead server: fail the handle now with the diagnostic
           // instead of blocking Wait until the heartbeat detector.
+          RecClear(p);
           FailHandle(handle, p->key, std::move(ack));
           queue_->ReleaseCredit(raw_len);
           return;
@@ -417,6 +600,7 @@ void BytePSWorker::SendPush(PushOp op) {
                   (long long)p->key);
         Record(p->key, "push", t_push);
         BPS_METRIC_HISTO_OBSERVE("bps_push_us", NowUs() - t_push);
+        RecTrackAck(p);
         // Async: the ack carries the server's fleet-wide apply count
         // for this key as of OUR push; the pull resp carries it as
         // of the pull. Their difference is this pull's staleness.
@@ -431,9 +615,10 @@ void BytePSWorker::SendPush(PushOp op) {
         int64_t t_pull = NowUs();
         kv_->Request(
             p->server_id, ph, nullptr, 0,
-            [this, ctx, p, base, raw_len, scale, handle, t_pull,
-             flags, at_push](Message&& resp) {
+            [this, ctx, p, base, raw_len, version, scale, handle,
+             t_pull, flags, at_push](Message&& resp) {
               if (resp.head.cmd == CMD_ERROR) {
+                RecClear(p);
                 FailHandle(handle, p->key, std::move(resp));
                 queue_->ReleaseCredit(raw_len);
                 return;
@@ -482,6 +667,9 @@ void BytePSWorker::SendPush(PushOp op) {
                     << "pull length mismatch for key " << p->key;
                 memcpy(base, resp.payload.data(), raw_len);
               }
+              // Before Scale: the retained re-seed payload must be the
+              // server's slot bytes (the unscaled sum).
+              RecTrackDone(p, version, base, raw_len);
               if (scale != 1.0) {
                 CpuReducer::Scale(base, scale, raw_len, ctx->dtype);
               }
@@ -492,6 +680,7 @@ void BytePSWorker::SendPush(PushOp op) {
               }
             });
       });
+  RecTrackPushRid(p, push_rid);
 }
 
 // Validate a CMD_MULTI_* reply frame and return its sub-header table;
@@ -556,15 +745,33 @@ void BytePSWorker::SendFusedPush(int server_id, std::vector<PushOp> ops) {
   BPS_METRIC_COUNTER_ADD("bps_fused_msgs_total", 1);
   BPS_METRIC_HISTO_OBSERVE("bps_fusion_batch_keys", n);
   int64_t t_push = NowUs();
+  if (recovery_on_) {
+    std::lock_guard<std::mutex> lk(rec_mu_);
+    for (PushOp& op : *batch) {
+      op.p->rec_op = op;
+      op.p->rec_stage = 1;
+      op.p->rec_push_rid = -1;
+    }
+  }
   // The iovec list lives only until RequestV returns (it snapshots the
   // segments when retry is on); the table is pinned via the hold, the
   // payload segments via caller buffers / comp_bufs until the handles
   // settle.
-  kv_->RequestV(server_id, h, segs.data(), static_cast<int>(segs.size()),
-                [this, server_id, batch, t_push](Message&& ack) {
-                  OnFusedAck(server_id, batch, t_push, std::move(ack));
-                },
-                table_hold);
+  int push_rid = kv_->RequestV(
+      server_id, h, segs.data(), static_cast<int>(segs.size()),
+      [this, server_id, batch, t_push](Message&& ack) {
+        OnFusedAck(server_id, batch, t_push, std::move(ack));
+      },
+      table_hold);
+  if (recovery_on_) {
+    // One req id covers the whole frame; each sub-op records it so the
+    // recovery hook can tell "frame still in the resend queue" from
+    // "frame settled, contributions live only in the dead server".
+    std::lock_guard<std::mutex> lk(rec_mu_);
+    for (PushOp& op : *batch) {
+      if (op.p->rec_stage == 1) op.p->rec_push_rid = push_rid;
+    }
+  }
 }
 
 void BytePSWorker::OnFusedAck(
@@ -575,6 +782,10 @@ void BytePSWorker::OnFusedAck(
     return;
   }
   const int n = static_cast<int>(batch->size());
+  if (recovery_on_) {
+    std::lock_guard<std::mutex> lk(rec_mu_);
+    for (PushOp& op : *batch) op.p->rec_stage = 2;
+  }
   const char* gathered = nullptr;
   const SubHeader* subs = ParseMultiReply(ack, CMD_MULTI_ACK, n, &gathered);
   auto at_push = std::make_shared<std::vector<int64_t>>(
@@ -671,6 +882,7 @@ void BytePSWorker::OnFusedPullResp(
           << "pull length mismatch for key " << op.p->key;
       memcpy(op.base, data, static_cast<size_t>(op.raw_len));
     }
+    RecTrackDone(op.p, op.version, op.base, op.raw_len);
     if (op.scale != 1.0) {
       CpuReducer::Scale(op.base, op.scale, op.raw_len, op.ctx->dtype);
     }
@@ -688,6 +900,7 @@ void BytePSWorker::FailBatch(
     Message e;
     e.head = err.head;
     e.payload.assign(err.payload.begin(), err.payload.end());
+    RecClear(op.p);
     FailHandle(op.handle, op.p->key, std::move(e));
     queue_->ReleaseCredit(op.raw_len);
   }

@@ -98,25 +98,8 @@ class BytePSWorker {
   }
 
  private:
-  struct Part {
-    int64_t key;
-    int server_id;  // postoffice node id
-    int64_t offset;  // elements
-    int64_t len;     // elements
-    std::unique_ptr<Compressor> comp;
-    std::vector<char> comp_buf;
-  };
-
-  struct TensorCtx {
-    int64_t id;
-    std::string name;
-    int64_t nelem;
-    int dtype;
-    int priority;
-    int64_t round = 0;
-    int64_t bcast_round = 0;  // broadcast round (head.version on BCAST_*)
-    std::vector<Part> parts;
-  };
+  struct Part;
+  struct TensorCtx;
 
   struct Handle {
     std::atomic<int> remaining;
@@ -140,6 +123,44 @@ class BytePSWorker {
     int version = 0;
     double scale = 1.0;
     std::shared_ptr<Handle> handle;
+  };
+
+  struct Part {
+    int64_t key;
+    int server_id;  // postoffice node id
+    int64_t offset;  // elements
+    int64_t len;     // elements
+    std::unique_ptr<Compressor> comp;
+    std::vector<char> comp_buf;
+    // Hot-replacement recovery state (ISSUE 4; guarded by rec_mu_,
+    // maintained only when recovery is armed). The sync step keeps at
+    // most ONE op per key in flight, so one slot is a complete record:
+    //   rec_stage 0: idle — reseed_data holds round reseed_round's
+    //     unscaled aggregate (the authoritative re-seed payload);
+    //   rec_stage 1: push issued (rec_push_rid = its request id; while
+    //     the request is pending, the resend queue re-delivers it);
+    //   rec_stage 2: push ACKED, pull in flight — the dead server's
+    //     partial sum held our contribution, so recovery must RE-PUSH
+    //     it (rec_op's payload pointers stay valid: the handle has not
+    //     settled, so the caller buffer / comp_buf are alive and the
+    //     pull has not overwritten them).
+    int rec_stage = 0;
+    int rec_push_rid = -1;
+    PushOp rec_op;
+    std::vector<char> reseed_data;
+    int reseed_round = -1;
+  };
+
+  struct TensorCtx {
+    int64_t id;
+    std::string name;
+    int64_t nelem;
+    int dtype;
+    int priority;
+    int64_t round = 0;
+    int64_t bcast_round = 0;  // broadcast round (head.version on BCAST_*)
+    std::string comp_config;  // resolved codec config (recovery re-declare)
+    std::vector<Part> parts;
   };
 
   void PushLoop();
@@ -166,6 +187,27 @@ class BytePSWorker {
   // release its credits.
   void FailBatch(const std::shared_ptr<std::vector<PushOp>>& batch,
                  Message&& err);
+
+ public:
+  // Hot server replacement (ISSUE 4): the postoffice's peer-recovered
+  // callback lands here (van recv thread). Spawns a background thread
+  // that re-declares the dead rank's key shard on the replacement,
+  // re-pushes settled in-flight contributions, RESEEDs completed rounds
+  // from this worker's retained aggregates, then drains the parked
+  // resend queue (KVWorker::ResendNode).
+  void OnServerRecovered(int node_id);
+
+ private:
+  void RecoverServer(int node_id);
+  // Recovery bookkeeping around a push send (stage 1 + request id).
+  void RecTrackPush(Part* p, const PushOp& op);
+  void RecTrackPushRid(Part* p, int rid);
+  // Push acked: the dead-server recovery must re-push from rec_op.
+  void RecTrackAck(Part* p);
+  // Pull landed: retain the round's unscaled aggregate for RESEED.
+  void RecTrackDone(Part* p, int version, const char* base,
+                    int64_t raw_len);
+  void RecClear(Part* p);
 
   Postoffice* po_ = nullptr;
   KVWorker* kv_ = nullptr;
@@ -201,6 +243,14 @@ class BytePSWorker {
 
   std::unique_ptr<ScheduledQueue> queue_;
   std::vector<std::thread> push_threads_;
+
+  // Recovery (ISSUE 4): armed when RecoveryEnabled(); rec_mu_ guards
+  // every Part's rec_*/reseed_* fields (writers are the per-key
+  // executor callbacks; the reader is a RecoverServer thread).
+  bool recovery_on_ = false;
+  std::mutex rec_mu_;
+  std::mutex rec_threads_mu_;
+  std::vector<std::thread> rec_threads_;
 
   std::mutex trace_mu_;
   std::vector<TraceEvent> trace_;

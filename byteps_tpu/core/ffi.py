@@ -160,6 +160,11 @@ def _apply_config_env(cfg: Optional[Config]) -> None:
     os.environ["BYTEPS_RETRY_TIMEOUT_MS"] = str(cfg.retry_timeout_ms)
     os.environ["BYTEPS_RECONNECT_MAX"] = str(cfg.reconnect_max)
     os.environ["BYTEPS_RECONNECT_BACKOFF_MS"] = str(cfg.reconnect_backoff_ms)
+    # Hot server replacement (ISSUE 4). DMLC_RECOVER_RANK is deliberately
+    # NOT projected: it is per-process identity owned by the supervisor,
+    # never a fleet-wide setting.
+    os.environ["BYTEPS_RECOVERY_TIMEOUT_MS"] = str(
+        cfg.effective_recovery_timeout_ms)
     os.environ["BYTEPS_CHAOS_SEED"] = str(cfg.chaos_seed)
     os.environ["BYTEPS_CHAOS_DROP"] = str(cfg.chaos_drop)
     os.environ["BYTEPS_CHAOS_DUP"] = str(cfg.chaos_dup)

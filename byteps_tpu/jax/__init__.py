@@ -40,6 +40,7 @@ from byteps_tpu.parallel import hierarchical as _h
 from byteps_tpu.parallel.mesh import build_mesh, set_global_mesh
 from byteps_tpu.partition import TensorRegistry
 
+from byteps_tpu.jax._compat import axis_size as _axis_size
 from byteps_tpu.jax._compat import shard_map as _shard_map
 
 __all__ = [
@@ -203,7 +204,7 @@ def _inside_spmd(axis: Optional[str]) -> bool:
     if axis is None:
         return False
     try:
-        lax.axis_size(axis)
+        _axis_size(axis)
         return True
     except Exception:  # unbound axis name outside shard_map
         return False

@@ -1,8 +1,22 @@
-"""JAX version compatibility shims."""
+"""JAX version compatibility shims.
+
+All in-tree code (library, tests, examples) that touches an API renamed
+or added across the supported jax range goes through this module instead
+of jax directly:
+
+- ``shard_map``: top-level on jax >= 0.8, ``jax.experimental.shard_map``
+  before; the replication-check kwarg renamed check_rep -> check_vma.
+- ``axis_size``: ``jax.lax.axis_size`` exists only on newer jax; older
+  versions spell it ``lax.psum(1, axis)`` (statically evaluated, so it
+  is a Python int inside shard_map either way, and raises NameError on
+  an unbound axis exactly like the real one).
+"""
 
 from __future__ import annotations
 
 import inspect
+
+from jax import lax as _lax
 
 try:  # jax >= 0.8 exports shard_map at top level
     from jax import shard_map as _raw_shard_map
@@ -28,3 +42,16 @@ def shard_map(f=None, /, *, mesh, in_specs, out_specs, check_vma=True):
     if f is None:
         return lambda g: _raw_shard_map(g, **kwargs)
     return _raw_shard_map(f, **kwargs)
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` with a pre-axis_size-API fallback.
+
+    Inside ``shard_map``/``pmap`` both forms return the mapped axis size
+    as a Python int (``psum`` of a concrete constant is evaluated
+    statically); outside, both raise ``NameError`` for the unbound axis
+    name — callers that probe for "am I inside spmd?" rely on that.
+    """
+    if hasattr(_lax, "axis_size"):
+        return _lax.axis_size(axis_name)
+    return _lax.psum(1, axis_name)

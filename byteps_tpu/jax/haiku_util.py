@@ -19,6 +19,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 import byteps_tpu.jax as bps
+from byteps_tpu.jax._compat import axis_size as _axis_size
 from byteps_tpu.jax._compat import shard_map as _shard_map
 from byteps_tpu.jax.compression import Compression, Compressor
 
@@ -60,7 +61,7 @@ def make_haiku_train_step(
             return None
         idx = 0
         for ax in axes:
-            idx = idx * lax.axis_size(ax) + lax.axis_index(ax)
+            idx = idx * _axis_size(ax) + lax.axis_index(ax)
         return jax.random.fold_in(key, idx)
 
     def _sync(loss, grads):

@@ -81,19 +81,39 @@ def spawn_workers(command: Sequence[str], workers_per_host: int,
 _TERM_GRACE_S = 10.0
 
 
-def _reap(procs: List[subprocess.Popen], names: Optional[List[str]] = None
-          ) -> int:
+def _describe_exit(code: Optional[int]) -> str:
+    """Human attribution for a child's exit: signal name when killed,
+    plain code otherwise — post-mortems need to know WHICH role died and
+    HOW, not just that 'the fleet failed'."""
+    if code is not None and code < 0:
+        try:
+            signame = signal.Signals(-code).name
+        except ValueError:
+            signame = f"signal {-code}"
+        return f"signal {-code} ({signame})"
+    return f"exit code {code}"
+
+
+def _reap(procs: List[subprocess.Popen], names: Optional[List[str]] = None,
+          respawn=None, supervise: int = 0) -> int:
     """Wait for all children; on first failure kill the rest.
 
     Mirrors the reference launcher's fail-fast behavior: a dead worker
     must take the job down, not hang it. Survivors get SIGTERM, then
     SIGKILL after a grace period, so a child that traps SIGTERM (e.g. a
     checkpoint-on-term training script) cannot wedge the launcher.
+
+    --supervise mode: ``respawn(name)`` (when given) returns a fresh
+    Popen for a dead SERVER role — hot replacement via
+    DMLC_RECOVER_RANK — and up to ``supervise`` such respawns replace
+    the fail-fast for server children. Scheduler and worker deaths, and
+    server deaths past the budget, fail fast as before.
     """
     import time
 
     names = names or [f"proc{i}" for i in range(len(procs))]
     rc = 0
+    budget = supervise
     term_deadline = None
     try:
         remaining = dict(zip(names, procs))
@@ -110,8 +130,23 @@ def _reap(procs: List[subprocess.Popen], names: Optional[List[str]] = None
                     continue
                 del remaining[name]
                 if code != 0:
-                    print(f"bpslaunch: {name} exited with {code}",
-                          file=sys.stderr)
+                    # Failure attribution BEFORE any restart decision:
+                    # which role/rank died, its pid, and how.
+                    print(f"bpslaunch: {name} (pid {p.pid}) died with "
+                          f"{_describe_exit(code)}", file=sys.stderr,
+                          flush=True)
+                    if (respawn is not None and term_deadline is None
+                            and name.startswith("server") and budget > 0):
+                        budget -= 1
+                        fresh = respawn(name)
+                        if fresh is not None:
+                            print(f"bpslaunch: respawning {name} as hot "
+                                  f"replacement (pid {fresh.pid}, "
+                                  f"{budget} respawn(s) left)",
+                                  file=sys.stderr, flush=True)
+                            procs.append(fresh)
+                            remaining[name] = fresh
+                            continue
                     rc = rc or code
                     if remaining and term_deadline is None:
                         for q in remaining.values():
@@ -148,7 +183,7 @@ def _free_port() -> int:
 
 def launch_local_fleet(command: Sequence[str], num_workers: int,
                        num_servers: int, port: int, env: Dict[str, str],
-                       numa: bool = False) -> int:
+                       numa: bool = False, supervise: int = 0) -> int:
     """Bring up scheduler + servers + workers on 127.0.0.1 in one call
     (the reference needs tests/run_byteps_test.sh for this topology).
 
@@ -193,8 +228,14 @@ def launch_local_fleet(command: Sequence[str], num_workers: int,
     procs = [sched]
     names = ["scheduler"]
     for s in range(num_servers):
+        # DMLC_WORKER_ID pins the server's RANK to its launch index
+        # (the scheduler sorts registrations by preferred rank), so
+        # --supervise can respawn "server s" with DMLC_RECOVER_RANK=s
+        # and be certain it adopts the right shard.
         procs.append(
-            subprocess.Popen(server_cmd, env=_role_env(base, "server")))
+            subprocess.Popen(server_cmd,
+                             env=_role_env(base, "server",
+                                           DMLC_WORKER_ID=str(s))))
         names.append(f"server{s}")
     for w in range(num_workers):
         e = _role_env(base, "worker",
@@ -204,7 +245,22 @@ def launch_local_fleet(command: Sequence[str], num_workers: int,
         prefix = _numa_prefix(w) if numa else []
         procs.append(subprocess.Popen(prefix + list(command), env=e))
         names.append(f"worker{w}")
-    return _reap(procs, names)
+    # Pid map for operators (and the recovery tests): supervision and
+    # post-mortems need to know which pid is which role.
+    for name, p in zip(names, procs):
+        print(f"bpslaunch: spawned {name} pid={p.pid}", file=sys.stderr,
+              flush=True)
+
+    def _respawn_server(name: str) -> Optional[subprocess.Popen]:
+        # Hot replacement: respawn ONLY the dead server role, marked
+        # with DMLC_RECOVER_RANK so it adopts the dead rank's id and
+        # key shard instead of joining fleet formation.
+        rank = int(name[len("server"):])
+        e = _role_env(base, "server", DMLC_RECOVER_RANK=str(rank))
+        return subprocess.Popen(server_cmd, env=e)
+
+    return _reap(procs, names, respawn=_respawn_server if supervise else None,
+                 supervise=supervise)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -235,6 +291,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "fleet (BYTEPS_FUSION_BYTES): partitions under N "
                         "raw bytes coalesce into multi-key wire frames; "
                         "0 disables fusion (default: inherit env, 65536)")
+    p.add_argument("--supervise", type=int, metavar="N", default=0,
+                   help="--local mode: per-child supervision — respawn a "
+                        "dead SERVER role (up to N times total) as a hot "
+                        "replacement with DMLC_RECOVER_RANK set, instead "
+                        "of failing the whole fleet; the scheduler "
+                        "coordinates the epoch pause + shard re-seed "
+                        "(requires BYTEPS_RECOVERY_TIMEOUT_MS > 0, the "
+                        "default). Scheduler/worker deaths still fail "
+                        "fast (pair with --restarts for those)")
     p.add_argument("--restarts", type=int, default=0,
                    help="--local mode: relaunch the whole fleet up to N "
                         "times after a failed run (elastic-ish recovery: "
@@ -285,7 +350,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         import time
 
         rc = launch_local_fleet(command, args.local, args.num_servers,
-                                args.port, dict(os.environ), numa=args.numa)
+                                args.port, dict(os.environ), numa=args.numa,
+                                supervise=args.supervise)
         for attempt in range(args.restarts):
             if rc == 0:
                 break
@@ -302,7 +368,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 time.sleep(delay)
             rc = launch_local_fleet(command, args.local, args.num_servers,
                                     args.port, dict(os.environ),
-                                    numa=args.numa)
+                                    numa=args.numa,
+                                    supervise=args.supervise)
         return rc
 
     role = os.environ.get("DMLC_ROLE", "worker").lower()

@@ -23,6 +23,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from byteps_tpu.jax._compat import axis_size as _axis_size
+
 from byteps_tpu.models.transformer import _attention_fn, _default_positions
 
 
@@ -86,7 +88,7 @@ class LlamaAttention(nn.Module):
             # the all-to-all bytes), expand per query group only after the
             # exchange, inside the inner kernel.
             from byteps_tpu.parallel.ulysses import ulysses_attention
-            if self.num_kv_heads % jax.lax.axis_size(self.sp_axis) == 0:
+            if self.num_kv_heads % _axis_size(self.sp_axis) == 0:
                 if self.attn_impl == "flash":
                     from byteps_tpu.ops.flash_attention import \
                         flash_attention as _inner

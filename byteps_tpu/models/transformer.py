@@ -21,6 +21,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from byteps_tpu.jax._compat import axis_size as _axis_size
+
 from byteps_tpu.parallel.ring_attention import full_attention, ring_attention
 from byteps_tpu.parallel.ulysses import ulysses_attention
 
@@ -224,7 +226,7 @@ def sp_lm_loss(logits: jax.Array, tokens: jax.Array, axis: str) -> jax.Array:
     value is scaled so ``pmean`` over ``axis`` (and over any
     disjoint-batch DP axes) equals the full-sequence ``lm_loss`` exactly.
     """
-    k = jax.lax.axis_size(axis)
+    k = _axis_size(axis)
     if k == 1:
         return lm_loss(logits, tokens)
     idx = jax.lax.axis_index(axis)

@@ -36,9 +36,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 dead = snap.get("dead_nodes", [])
                 node = snap.get("node", {})
                 counters = snap.get("counters", {})
+                gauges = snap.get("gauges", {})
+                recovering = bool(gauges.get("bps_recovering", 0))
                 healthy = bool(node.get("inited")) and not dead
+                # Fleet state: RECOVERING while a server rank is being
+                # hot-replaced (healthy-but-paused, NOT degraded — the
+                # scheduler is coordinating; 200 so orchestrators don't
+                # kill a fleet that is saving itself).
+                state = ("RECOVERING" if recovering
+                         else "OK" if healthy else "DEGRADED")
                 body = json.dumps({
                     "status": "ok" if healthy else "degraded",
+                    "state": state,
                     "inited": bool(node.get("inited")),
                     "role": node.get("role"),
                     "node_id": node.get("id"),
@@ -49,6 +58,11 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     "retries": int(counters.get("bps_retries_total", 0)),
                     "reconnects": int(
                         counters.get("bps_reconnects_total", 0)),
+                    # Hot-replacement telemetry: completed recoveries and
+                    # the fleet membership epoch (bumped per recovery).
+                    "recoveries": int(
+                        counters.get("bps_recoveries_total", 0)),
+                    "epoch": int(gauges.get("bps_membership_epoch", 0)),
                     "uptime_s": round(
                         time.monotonic() - self.server.started_at, 3),
                 }).encode()

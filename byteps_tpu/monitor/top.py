@@ -91,6 +91,10 @@ def analyze(scrapes: Dict[str, Optional[dict]],
             # BEFORE the node goes dead.
             "retries": int(_sample(m, "bps_retries_total")),
             "reconnects": int(_sample(m, "bps_reconnects_total")),
+            # Hot-replacement telemetry: server recoveries this worker
+            # re-seeded, and whether one is in progress right now.
+            "recoveries": int(_sample(m, "bps_recoveries_total")),
+            "recovering": bool(_sample(m, "bps_recovering")),
         }
 
     # A worker actively riding the retry layer is flagged separately
@@ -110,6 +114,9 @@ def analyze(scrapes: Dict[str, Optional[dict]],
 
     stale_nodes: List[int] = []
     dead_nodes: List[int] = []
+    epoch = 0
+    recovering = any(w.get("recovering") for w in workers.values())
+    recoveries = 0
     sched = scrapes.get("scheduler")
     if sched:
         for labels in sched.get("bps_node_dead", {}):
@@ -118,6 +125,12 @@ def analyze(scrapes: Dict[str, Optional[dict]],
                                         {}).items():
             if age_ms > heartbeat_timeout_s * 1000.0:
                 stale_nodes.append(int(dict(labels)["node"]))
+        # Recovery state is authoritative at the scheduler: the
+        # membership epoch climbs once per hot replacement, and
+        # bps_recovering is 1 while the fleet is paused for one.
+        epoch = int(_sample(sched, "bps_membership_epoch"))
+        recovering = recovering or bool(_sample(sched, "bps_recovering"))
+        recoveries = int(_sample(sched, "bps_recoveries_total"))
 
     return {
         "workers": workers,
@@ -127,6 +140,10 @@ def analyze(scrapes: Dict[str, Optional[dict]],
         "stale_nodes": sorted(stale_nodes),
         "dead_nodes": sorted(dead_nodes),
         "unreachable": sorted(n for n, m in scrapes.items() if m is None),
+        # Hot-replacement fleet state (docs/monitoring.md "Recovery").
+        "epoch": epoch,
+        "recovering": recovering,
+        "recoveries": recoveries,
     }
 
 
@@ -137,6 +154,12 @@ def _print_report(report: dict, as_json: bool) -> None:
     print(f"{'worker':<10} {'push/s':>8} {'push MB':>9} {'pull MB':>9} "
           f"{'mean push':>10} {'queue':>6} {'credit':>14} {'rtry':>5} "
           f"{'reconn':>6} flags")
+    if report.get("recovering"):
+        print(f"fleet: RECOVERING (membership epoch {report['epoch']}; "
+              "a server rank is being hot-replaced)")
+    elif report.get("epoch"):
+        print(f"fleet: epoch {report['epoch']} "
+              f"({report.get('recoveries', 0)} recovery(ies) completed)")
     for name in sorted(report["workers"]):
         w = report["workers"][name]
         flags = []
@@ -144,6 +167,10 @@ def _print_report(report: dict, as_json: bool) -> None:
             flags.append("STRAGGLER")
         if name in report.get("retrying", []):
             flags.append("RETRYING")
+        if w.get("recovering"):
+            flags.append("RECOVERING")
+        elif w.get("recoveries"):
+            flags.append(f"RECOVERED×{w['recoveries']}")
         credit = (f"{w['inflight_bytes'] >> 10}/"
                   f"{w['credit_budget_bytes'] >> 10}K")
         print(f"{name:<10} {w['push_count']:>8} "

@@ -27,11 +27,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from byteps_tpu.jax._compat import axis_size as _compat_axis_size
+
 ReduceFn = Callable[[jax.Array], jax.Array]
 
 
 def _axis_size(axis: Optional[str]) -> int:
-    return lax.axis_size(axis) if axis else 1
+    return _compat_axis_size(axis) if axis else 1
 
 
 def hierarchical_all_reduce(

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from byteps_tpu.jax._compat import axis_size as _axis_size
+
 
 def moe_dispatch(gate_logits: jax.Array, capacity: int,
                  _legacy_capacity: Optional[int] = None):
@@ -136,7 +138,7 @@ def moe_ffn(
     t, d = x.shape
     e = gate_w.shape[1]
     logits = x @ gate_w
-    n = lax.axis_size(ep_axis) if ep_axis else 1
+    n = _axis_size(ep_axis) if ep_axis else 1
     # Per-DEVICE capacity (GShard): each device dispatches at most
     # cf·t_local/e slots per expert, keeping per-device slot volume at 1/n
     # of the dense problem (imbalance beyond cf is dropped, by design).

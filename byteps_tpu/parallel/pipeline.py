@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from byteps_tpu.jax._compat import axis_size as _axis_size
+
 
 def gpipe(
     stage_fn: Callable,
@@ -45,7 +47,7 @@ def gpipe(
     all-gather-free ppermute ring closes the loop: the last stage feeds
     device 0's carry, which is where outputs are read off).
     """
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     idx = lax.axis_index(axis)
     m = microbatches.shape[0]
     act_shape = microbatches.shape[1:]
@@ -123,7 +125,7 @@ def pipeline_1f1b(
     device's stage-parameter gradients (of the mean loss) — apply your
     optimizer per stage locally; no jax.grad around this is needed.
     """
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     idx = lax.axis_index(axis)
     m = microbatches.shape[0]
     act_shape = microbatches.shape[1:]

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from byteps_tpu.jax._compat import axis_size as _axis_size
+
 
 def _big_neg(dtype) -> float:
     return float(jnp.finfo(dtype).min) / 2
@@ -76,7 +78,7 @@ def ring_attention(
     ``causal`` masks by *global* position, so the result equals full causal
     attention on the gathered sequence.
     """
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     my = lax.axis_index(axis)
     b, s_q, h, d = q.shape
     if scale is None:

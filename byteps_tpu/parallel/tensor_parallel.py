@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from byteps_tpu.jax._compat import axis_size as _axis_size
+
 
 def column_parallel(x: jax.Array, w_shard: jax.Array,
                     b_shard: Optional[jax.Array] = None) -> jax.Array:
@@ -89,7 +91,7 @@ def shard_columns(w: jax.Array, axis: str = "tp") -> jax.Array:
     """Per-device code: slice the LAST dim of a replicated weight into
     this device's column shard (convenience for loading unsharded
     checkpoints under shard_map)."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     i = lax.axis_index(axis)
     cols = w.shape[-1] // n
     return lax.dynamic_slice_in_dim(w, i * cols, cols, axis=w.ndim - 1)
@@ -97,7 +99,7 @@ def shard_columns(w: jax.Array, axis: str = "tp") -> jax.Array:
 
 def shard_rows(w: jax.Array, axis: str = "tp") -> jax.Array:
     """Per-device code: slice the FIRST dim into this device's row shard."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     i = lax.axis_index(axis)
     rows = w.shape[0] // n
     return lax.dynamic_slice_in_dim(w, i * rows, rows, axis=0)

@@ -25,6 +25,8 @@ from typing import Callable, Optional
 import jax
 from jax import lax
 
+from byteps_tpu.jax._compat import axis_size as _axis_size
+
 from byteps_tpu.parallel.ring_attention import full_attention
 
 AttnFn = Callable[..., jax.Array]
@@ -58,7 +60,7 @@ def ulysses_attention(
     inner full-sequence attention (signature: (q, k, v, *, causal, scale));
     defaults to the exact softmax attention.
     """
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     h = q.shape[2]
     if h % n != 0:
         raise ValueError(

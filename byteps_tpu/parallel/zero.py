@@ -32,6 +32,8 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from byteps_tpu.jax._compat import axis_size as _axis_size
+
 
 def _flatten(tree) -> Tuple[jax.Array, list, list, "jax.tree_util.PyTreeDef"]:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -58,7 +60,7 @@ def zero_init(params, optimizer: optax.GradientTransformation,
     """Per-device code: initialise THIS device's optimizer-state shard
     (state over the f32 flat shard; padding is recomputed by
     ``zero_apply``)."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     idx = lax.axis_index(axis)
     flat, _, _, _ = _flatten(params)
     pad = (-flat.shape[0]) % n
@@ -79,7 +81,7 @@ def zero_apply(params, grads, opt_state_shard,
     equivalent of the all-reduce's first half. Returns
     ``(new_params, new_opt_state_shard)``.
     """
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     idx = lax.axis_index(axis)
     flat_p, shapes, dtypes, treedef = _flatten(params)
     flat_g, _, _, _ = _flatten(grads)

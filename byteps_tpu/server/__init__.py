@@ -15,6 +15,14 @@ import sys
 
 def main() -> None:
     role = os.environ.get("DMLC_ROLE", "server").lower()
+    recover_rank = os.environ.get("DMLC_RECOVER_RANK", "")
+    if recover_rank and role == "server":
+        # Hot replacement (ISSUE 4): this incarnation adopts a dead
+        # server rank's id + key shard. Start() registers with the
+        # recovery marker; the scheduler answers with a direct ADDRBOOK
+        # and broadcasts the epoch RESUME, and the workers re-seed us.
+        print(f"byteps_tpu.server: starting as hot replacement for "
+              f"server rank {recover_rank}", file=sys.stderr, flush=True)
     from byteps_tpu.core import Scheduler, Server
     if role == "scheduler":
         node = Scheduler.start()

@@ -664,6 +664,62 @@ def main() -> int:
             }), flush=True)
             w.barrier(GROUP_WORKERS)
 
+        elif mode == "recovery":
+            # Hot server replacement acceptance (ISSUE 4): a chaos-style
+            # multi-round, many-tensor run — integer-valued floats, so
+            # summation is exact and digests compare BITWISE across
+            # runs — paced so the parent can SIGKILL a server mid-round
+            # and respawn it with DMLC_RECOVER_RANK. The run must
+            # complete with the same digest as the fault-free run, and
+            # the counters prove a recovery actually happened.
+            import hashlib
+            import json
+            import time as _t
+
+            sizes = [64, 96, 128, 192, 256, 384, 512, 768, 1024,
+                     1536] * 3  # 30 tensors, 256 B .. 6 KiB
+            tids = [w.declare(f"rc{i}", n, "float32", compression="")
+                    for i, n in enumerate(sizes)]
+            bc = w.declare("rc_bc", 512, "float32", compression="")
+            arr_bc = (np.arange(512, dtype=np.float32) if rank == 0
+                      else np.zeros(512, np.float32))
+            w.wait(w.broadcast(bc, arr_bc, root_rank=0))
+            np.testing.assert_array_equal(
+                arr_bc, np.arange(512, dtype=np.float32))
+            digest = hashlib.sha256()
+            digest.update(arr_bc.tobytes())
+            scale = sum(r + 1 for r in range(nw))
+            rounds = int(os.environ.get("BPS_TEST_ROUNDS", "8"))
+            sleep_s = float(os.environ.get("BPS_TEST_ROUND_SLEEP", "0.3"))
+            for rnd in range(rounds):
+                staged = []
+                for i, (tid, n) in enumerate(zip(tids, sizes)):
+                    base = (np.arange(n) % 89 + i + rnd + 1).astype(
+                        np.float32)
+                    arr = np.ascontiguousarray(base * (rank + 1))
+                    staged.append((w.push_pull(tid, arr, average=False),
+                                   arr, base))
+                for h, arr, base in staged:
+                    w.wait(h)
+                    np.testing.assert_array_equal(arr, base * scale)
+                    digest.update(arr.tobytes())
+                print(f"round {rnd}", flush=True)
+                _t.sleep(sleep_s)
+            w.barrier(GROUP_WORKERS)  # all counters final
+            snap = w.metrics_snapshot()
+            print(json.dumps({
+                "digest": digest.hexdigest(),
+                "recoveries": snap["counters"].get(
+                    "bps_recoveries_total", 0),
+                "epoch": snap["gauges"].get("bps_membership_epoch", 0),
+                "retries": snap["counters"].get("bps_retries_total", 0),
+                "reconnects": snap["counters"].get(
+                    "bps_reconnects_total", 0),
+                "chaos_injected": snap["counters"].get(
+                    "bps_chaos_injected_total", 0),
+            }), flush=True)
+            w.barrier(GROUP_WORKERS)
+
         elif mode == "barrier":
             w.barrier(GROUP_WORKERS)
             print(f"rank {rank} passed barrier")

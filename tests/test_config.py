@@ -148,3 +148,57 @@ def test_chaos_env_roundtrip(monkeypatch):
     assert cfg.chaos_drop == 0.05 and cfg.chaos_dup == 0.01
     assert cfg.chaos_delay_us == 250 and cfg.chaos_reset_every == 500
     assert cfg.retry_max == 6 and cfg.retry_timeout_ms == 400
+
+
+def test_recovery_knob_validation():
+    """Hot-server-replacement knobs (ISSUE 4): ranges enforced, the
+    recovery window must clear a heartbeat round trip, and a replacement
+    incarnation (DMLC_RECOVER_RANK) only makes sense on a server process
+    in a fleet where recovery can actually run."""
+    with pytest.raises(ValueError, match="BYTEPS_RECOVERY_TIMEOUT_MS"):
+        Config(recovery_timeout_ms=-1).validate()
+    # The window must exceed PS_HEARTBEAT_TIMEOUT: a replacement cannot
+    # even register before the scheduler notices the death.
+    with pytest.raises(ValueError, match="must exceed PS_HEARTBEAT_TIMEOUT"):
+        Config(recovery_timeout_ms=5000, heartbeat_interval_s=1.0,
+               heartbeat_timeout_s=30.0).validate()
+    Config(recovery_timeout_ms=60000, heartbeat_interval_s=1.0,
+           heartbeat_timeout_s=30.0).validate()
+    # Heartbeats disabled: no death detection, relation vacuous.
+    Config(recovery_timeout_ms=5000, heartbeat_interval_s=0.0).validate()
+    # DMLC_RECOVER_RANK: server-only, in range, and recovery must be on.
+    Config(role="server", num_server=2, recover_rank=1).validate()
+    with pytest.raises(ValueError, match="server-process knob"):
+        Config(role="worker", num_server=2, recover_rank=1).validate()
+    with pytest.raises(ValueError, match="out of range"):
+        Config(role="server", num_server=2, recover_rank=2).validate()
+    with pytest.raises(ValueError, match="DMLC_RECOVER_RANK is set but"):
+        Config(role="server", num_server=2, recover_rank=0,
+               recovery_timeout_ms=0).validate()
+
+
+def test_recovery_requires_retry_implicitly():
+    """Re-seed rides the resend queue, so BYTEPS_RETRY_MAX=0 keeps its
+    documented restore-fail-fast-wholesale meaning: recovery is
+    implicitly off (effective window 0, projected to the C core), not a
+    validation error — but a replacement incarnation under retry-off IS
+    an error, because its re-seed could never arrive."""
+    cfg = Config(retry_max=0).validate()
+    assert cfg.recovery_timeout_ms == 60000  # raw knob untouched
+    assert cfg.effective_recovery_timeout_ms == 0
+    assert Config(retry_max=4).effective_recovery_timeout_ms == 60000
+    with pytest.raises(ValueError, match="BYTEPS_RETRY_MAX=0"):
+        Config(role="server", num_server=2, recover_rank=1,
+               retry_max=0).validate()
+
+
+def test_recovery_env_roundtrip(monkeypatch):
+    monkeypatch.setenv("DMLC_ROLE", "server")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "2")
+    monkeypatch.setenv("BYTEPS_RECOVERY_TIMEOUT_MS", "45000")
+    monkeypatch.setenv("DMLC_RECOVER_RANK", "1")
+    cfg = load_config()
+    assert cfg.recovery_timeout_ms == 45000
+    assert cfg.recover_rank == 1
+    monkeypatch.delenv("DMLC_RECOVER_RANK")
+    assert load_config().recover_rank is None

@@ -8,6 +8,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from byteps_tpu.jax._compat import axis_size as _axis_size
+
 from byteps_tpu.jax._compat import shard_map as _shard_map
 from byteps_tpu.parallel.pipeline import gpipe, stage_params
 
@@ -83,7 +85,7 @@ def test_gpipe_training_matches_sequential(rng):
             out = gpipe(_stage_fn, stage_params(p_), x)
             # the output (and hence loss) is replicated on every device;
             # scale so the backward psums reconstitute the dense gradient
-            return jnp.mean((out - y) ** 2) / jax.lax.axis_size("pp")
+            return jnp.mean((out - y) ** 2) / _axis_size("pp")
 
         g = jax.grad(loss)(p)
         # each device only contributes its own stage's grad; sum shards

@@ -161,12 +161,19 @@ def test_van_shm_tiny_ring_streams_large_frames():
 def _run_dead_server_fast_fail(extra_env):
     """Kill the only server once the worker is mid-flight; the worker's
     peer-lost hook must fail the handle in seconds (not the 30 s
-    heartbeat detector) and the worker script reports fast-fail OK."""
+    heartbeat detector) and the worker script reports fast-fail OK.
+
+    Hot server replacement is explicitly DISABLED here: with it on (the
+    default) a dead server parks its requests awaiting a replacement
+    instead of fast-failing — that path is covered by test_recovery.py;
+    this helper pins the recovery-off fail-fast contract."""
     from tests.ps_utils import free_port, spawn_role, spawn_worker, \
         topology_env
 
+    merged = {"BYTEPS_RECOVERY_TIMEOUT_MS": "0"}
+    merged.update(extra_env or {})
     port = free_port()
-    env = topology_env(1, 1, port, extra_env)
+    env = topology_env(1, 1, port, merged)
     sched = spawn_role("scheduler", env)
     server = spawn_role("server", env)
     worker = spawn_worker(WORKER, env, 0, "fast_fail")
@@ -363,8 +370,12 @@ def test_failure_detection_dead_server():
         topology_env
 
     port = free_port()
+    # Recovery off: this test pins the heartbeat-timeout FAIL-STOP for a
+    # dead server; the hot-replacement path (recovery on, the default)
+    # is covered by test_recovery.py.
     env = topology_env(2, 1, port, {"PS_HEARTBEAT_INTERVAL": "1",
-                                    "PS_HEARTBEAT_TIMEOUT": "3"})
+                                    "PS_HEARTBEAT_TIMEOUT": "3",
+                                    "BYTEPS_RECOVERY_TIMEOUT_MS": "0"})
     sched = spawn_role("scheduler", env)
     server = spawn_role("server", env)
     workers = [spawn_worker(WORKER, env, r, "slow") for r in range(2)]

@@ -1,0 +1,262 @@
+"""Hot server replacement tests (ISSUE 4): membership epochs, the
+scheduler's RECOVERY state, worker-side shard re-seed, and the launcher's
+per-child supervision.
+
+The acceptance bar is bitwise: SIGKILL one server mid-round in a 2w x 2s
+training-shaped run, respawn it with DMLC_RECOVER_RANK, and the run must
+COMPLETE with aggregates bit-identical to the fault-free run — with
+``bps_recoveries_total == 1`` proving the recovery actually happened.
+The no-replacement variant proves the timeout falls back to PR 3's
+fail-stop (nonzero exits), so behavior strictly improves.
+
+Run the selection alone with `pytest -m recovery`.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tests.ps_utils import (free_port, run_topology, spawn_role,
+                            spawn_worker, topology_env)
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_ps_worker.py")
+
+pytestmark = [pytest.mark.ps, pytest.mark.recovery]
+
+# Tight clocks so a full kill -> detect -> replace -> re-seed cycle fits
+# in seconds. BYTEPS_LOG_LEVEL=INFO lets the tests parse each server's
+# assigned node id ("node started: role=1 id=N") to target the kill.
+RECOVERY_ENV = {
+    "PS_HEARTBEAT_INTERVAL": "0.5",
+    "PS_HEARTBEAT_TIMEOUT": "2",
+    "BYTEPS_RECOVERY_TIMEOUT_MS": "20000",
+    "BYTEPS_RETRY_TIMEOUT_MS": "300",
+    "BYTEPS_RECONNECT_BACKOFF_MS": "50",
+    "BYTEPS_LOG_LEVEL": "INFO",
+}
+
+_clean_digest_cache = {}
+
+
+def _clean_digest():
+    """Digest of the fault-free 2w x 2s recovery-mode run (cached: it is
+    the bit-identity oracle for every fault variant)."""
+    if "digest" not in _clean_digest_cache:
+        extra = dict(RECOVERY_ENV)
+        extra["BPS_TEST_ROUND_SLEEP"] = "0"
+        outs = run_topology(2, 2, WORKER, mode="recovery", extra=extra,
+                            timeout=180.0)
+        rows = [json.loads(ln) for o in outs for ln in o.splitlines()
+                if ln.startswith("{")]
+        assert len(rows) == 2, outs
+        assert all(r["recoveries"] == 0 for r in rows), rows
+        assert all(r["epoch"] == 0 for r in rows), rows
+        assert len({r["digest"] for r in rows}) == 1, rows
+        _clean_digest_cache["digest"] = rows[0]["digest"]
+    return _clean_digest_cache["digest"]
+
+
+def _server_node_id(proc, timeout_s=60.0):
+    """Parse the assigned node id from a server's merged output."""
+    deadline = time.time() + timeout_s
+    for line in proc.stdout:
+        m = re.search(r"node started: role=1 id=(\d+)", line)
+        if m:
+            return int(m.group(1))
+        if time.time() > deadline:
+            break
+    raise AssertionError("server never logged its assigned node id")
+
+
+def _wait_for_round(worker, rnd, timeout_s=120.0):
+    deadline = time.time() + timeout_s
+    for line in worker.stdout:
+        if line.startswith(f"round {rnd}"):
+            return
+        if time.time() > deadline:
+            break
+    raise AssertionError(f"worker never reached round {rnd}")
+
+
+def _kill_and_recover_run(extra_env, respawn_delay_s):
+    """One 2w x 2s recovery-mode run: SIGKILL one server after round 1,
+    respawn it with DMLC_RECOVER_RANK after `respawn_delay_s`, reap the
+    fleet. Returns the workers' result rows."""
+    port = free_port()
+    env = topology_env(2, 2, port, extra_env)
+    sched = spawn_role("scheduler", env)
+    servers = [spawn_role("server", env) for _ in range(2)]
+    workers = [spawn_worker(WORKER, env, r, "recovery") for r in range(2)]
+    replacement = None
+    try:
+        victim = servers[0]
+        victim_id = _server_node_id(victim)
+        _wait_for_round(workers[0], 1)
+        victim.kill()  # hard death: no goodbye, sockets reset
+        # respawn_delay_s > heartbeat timeout exercises the
+        # detection-first path (PAUSE broadcast, RECOVERY wait); a short
+        # delay exercises the replacement-ahead-of-detection path.
+        time.sleep(respawn_delay_s)
+        renv = dict(env)
+        renv["DMLC_RECOVER_RANK"] = str(victim_id - 1)  # ServerId(s)=1+s
+        replacement = spawn_role("server", renv)
+
+        rows = []
+        for wp in workers:
+            out, _ = wp.communicate(timeout=150)
+            assert wp.returncode == 0, (
+                f"worker failed instead of recovering:\n{out}")
+            rows += [json.loads(ln) for ln in out.splitlines()
+                     if ln.startswith("{")]
+        # Clean teardown: the survivor, the replacement and the
+        # scheduler all exit 0 (normal fleet shutdown, no failure).
+        out1, _ = servers[1].communicate(timeout=30)
+        assert servers[1].returncode == 0, out1
+        out2, _ = replacement.communicate(timeout=30)
+        assert replacement.returncode == 0, out2
+        out3, _ = sched.communicate(timeout=30)
+        assert sched.returncode == 0, out3
+        assert len(rows) == 2, rows
+        return rows
+    finally:
+        procs = [sched, *servers, *workers]
+        if replacement is not None:
+            procs.append(replacement)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+
+def test_kill_one_server_hot_replacement_bit_identical():
+    """The tentpole acceptance: SIGKILL one of two servers mid-round;
+    the scheduler detects the death, enters RECOVERY at a bumped
+    membership epoch, the supervisor-respawned replacement adopts the
+    rank, the workers re-seed its shard and drain their parked resend
+    queues — and training completes BIT-IDENTICAL to the fault-free run
+    with exactly one recovery on every worker."""
+    rows = _kill_and_recover_run(RECOVERY_ENV, respawn_delay_s=4.0)
+    assert all(r["recoveries"] == 1 for r in rows), rows
+    assert all(r["epoch"] == 1 for r in rows), rows
+    assert len({r["digest"] for r in rows}) == 1, rows
+    assert rows[0]["digest"] == _clean_digest(), (
+        "recovered run diverged from the fault-free run", rows)
+
+
+def test_recovery_under_chaos_still_bit_identical():
+    """Transient faults DURING recovery: the chaos layer keeps dropping
+    and duplicating data-plane frames (including re-seed traffic) while
+    a server is killed and hot-replaced. Retry + dedup + recovery must
+    compose: same digest, one recovery, chaos provably armed."""
+    extra = dict(RECOVERY_ENV)
+    extra.update({
+        "BYTEPS_CHAOS_SEED": "11",
+        "BYTEPS_CHAOS_DROP": "0.02",
+        "BYTEPS_CHAOS_DUP": "0.02",
+    })
+    rows = _kill_and_recover_run(extra, respawn_delay_s=1.0)
+    assert all(r["recoveries"] == 1 for r in rows), rows
+    assert all(r["chaos_injected"] > 0 for r in rows), rows
+    assert sum(r["retries"] for r in rows) > 0, rows
+    assert len({r["digest"] for r in rows}) == 1, rows
+    assert rows[0]["digest"] == _clean_digest(), (
+        "chaos+recovery run diverged from the fault-free run", rows)
+
+
+def test_no_replacement_times_out_to_fail_stop():
+    """The fallback: a killed server with NO replacement must still
+    fail-stop the fleet cleanly (PR 3 behavior, delayed by the recovery
+    window): workers exit nonzero with the in-flight diagnostic, the
+    surviving server exits 2 via the failure shutdown, the scheduler
+    (which did its job) exits 0."""
+    port = free_port()
+    extra = dict(RECOVERY_ENV)
+    extra["BYTEPS_RECOVERY_TIMEOUT_MS"] = "3000"  # > heartbeat timeout
+    env = topology_env(2, 2, port, extra)
+    sched = spawn_role("scheduler", env)
+    servers = [spawn_role("server", env) for _ in range(2)]
+    workers = [spawn_worker(WORKER, env, r, "recovery") for r in range(2)]
+    try:
+        _wait_for_round(workers[0], 1)
+        servers[0].kill()
+        t0 = time.time()
+        out0, _ = workers[0].communicate(timeout=60)
+        detect_s = time.time() - t0
+        assert workers[0].returncode != 0, (
+            "worker must fail-stop when no replacement arrives:\n" + out0)
+        # heartbeat timeout (2 s) + recovery window (3 s) + margin
+        assert detect_s < 30, f"fail-stop fallback too slow: {detect_s}s"
+        assert ("request(s) in flight" in out0
+                or "byteps push/pull failed" in out0), out0
+        out1, _ = workers[1].communicate(timeout=30)
+        assert workers[1].returncode != 0, out1
+        srv_out, _ = servers[1].communicate(timeout=30)
+        assert servers[1].returncode != 0, (
+            "surviving server must exit nonzero on failure shutdown:\n"
+            + srv_out)
+        assert "failure shutdown" in srv_out, srv_out
+        sched_out, _ = sched.communicate(timeout=30)
+        assert sched.returncode == 0, sched_out
+        assert "no replacement for server" in sched_out, sched_out
+    finally:
+        for p in (sched, *servers, *workers):
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+
+def test_launcher_supervise_respawns_only_the_dead_server():
+    """Launcher satellite: `bpslaunch --local --supervise N` respawns
+    ONLY the dead server role — with DMLC_RECOVER_RANK and failure
+    attribution (role/rank, pid, signal) — and the fleet completes with
+    exit 0 instead of relaunching wholesale."""
+    from tests.ps_utils import REPO
+
+    env = dict(os.environ)
+    env.update(RECOVERY_ENV)
+    env.update({
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BPS_TEST_MODE": "recovery",
+        "BPS_TEST_ROUNDS": "8",
+        "BPS_TEST_ROUND_SLEEP": "0.3",
+    })
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.launcher", "--local", "2",
+         "--num-servers", "2", "--supervise", "2", "--",
+         sys.executable, WORKER],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        server_pid = None
+        deadline = time.time() + 120
+        consumed = []
+        for line in proc.stdout:
+            consumed.append(line)
+            m = re.match(r"bpslaunch: spawned server0 pid=(\d+)", line)
+            if m:
+                server_pid = int(m.group(1))
+            if line.startswith("round 1") and server_pid is not None:
+                break
+            if time.time() > deadline:
+                break
+        assert server_pid is not None, "".join(consumed)
+        os.kill(server_pid, signal.SIGKILL)
+        rest, _ = proc.communicate(timeout=180)
+        out = "".join(consumed) + rest
+        assert proc.returncode == 0, out
+        assert re.search(r"server0 \(pid \d+\) died with signal 9",
+                         out), out
+        assert "respawning server0 as hot replacement" in out, out
+        # Exactly one respawn consumed; the fleet was never relaunched.
+        assert out.count("respawning server0") == 1, out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()

@@ -8,6 +8,8 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
+from byteps_tpu.jax._compat import axis_size as _axis_size
+
 from byteps_tpu.jax._compat import shard_map as _shard_map
 from byteps_tpu.parallel.tensor_parallel import (
     shard_columns,
@@ -83,7 +85,7 @@ def test_tp_gradients_match_dense(mesh, rng):
         # The row-parallel output is replicated post-psum, so every device
         # computes the full loss; divide by the axis size so the psum in
         # the backward pass reconstitutes exactly the dense gradient.
-        n = jax.lax.axis_size("tp")
+        n = _axis_size("tp")
         gin_s, gout_s = jax.grad(
             lambda a, b_: jnp.sum(tp_mlp(x, a, b_) ** 2) / n,
             argnums=(0, 1))(shard_columns(w_in_), shard_rows(w_out_))

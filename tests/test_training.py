@@ -86,10 +86,7 @@ def test_training_converges():
     # DistributedOptimizer used directly inside a shard_map'd step
     from functools import partial
     from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from byteps_tpu.jax._compat import shard_map
     import optax as _optax
 
     @jax.jit
