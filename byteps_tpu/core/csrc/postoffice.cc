@@ -768,6 +768,15 @@ bool Postoffice::DialReplacement(int node_id, const NodeInfo& info) {
     if (streams < 1) streams = 1;
   }
   std::vector<int> fds;
+  // On any stripe failing, close everything dialed so far — fds not yet
+  // registered in node_fd_/node_extra_fds_ would otherwise leak (the
+  // caller falls back to the fail-stop path, but that may be minutes of
+  // retries away).
+  auto abandon = [&](int extra_fd) {
+    if (extra_fd >= 0) van_->CloseConn(extra_fd);
+    for (int f : fds) van_->CloseConn(f);
+    return false;
+  };
   for (int s = 0; s < streams; ++s) {
     // The replacement is already registered with the scheduler, so its
     // listener is up: a handful of dial attempts is plenty.
@@ -776,13 +785,13 @@ bool Postoffice::DialReplacement(int node_id, const NodeInfo& info) {
       BPS_LOG(WARNING) << "node " << my_id_
                        << ": cannot reach replacement server " << node_id
                        << " at " << info.host << ":" << info.port;
-      return false;
+      return abandon(-1);
     }
     MsgHeader hello{};
     hello.cmd = CMD_REGISTER;
     hello.sender = my_id_;
     hello.arg1 = role_;
-    if (!van_->Send(fd, hello)) return false;
+    if (!van_->Send(fd, hello)) return abandon(fd);
     fds.push_back(fd);
   }
   std::lock_guard<std::mutex> lk(mu_);

@@ -15,11 +15,17 @@ void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
   po_ = po;
   async_ = async_mode;
   const char* rr = getenv("DMLC_RECOVER_RANK");
-  recover_mode_ = rr && *rr;
-  if (recover_mode_) {
+  recover_mode_.store(rr && *rr);
+  if (recover_mode_.load()) {
+    // Grace window: the workers' re-declares must land within the same
+    // budget the scheduler gives the whole recovery. Past it, a data op
+    // for an unknown key is a protocol violation again (EndReseedGrace)
+    // — parking it would convert a real bug into an indefinite hang.
+    recover_grace_end_us_ = NowUs() + RecoveryTimeoutMs() * 1000;
     BPS_LOG(WARNING) << "server: starting as hot replacement (rank "
                      << rr << ") — re-seed state: unknown-key data ops "
-                        "park until their INIT_KEY re-declare arrives";
+                        "park until their INIT_KEY re-declare arrives "
+                        "(grace " << RecoveryTimeoutMs() << " ms)";
   }
   // Pre-register the server-side metric catalog so every /metrics page
   // serves the full series from zero — an idle server (no key routed to
@@ -330,12 +336,21 @@ void BytePSServer::Process(EngineTask&& task) {
   // from the dead predecessor may beat the worker's INIT_KEY
   // re-declares here. Park them (keepalive keeps the sender patient)
   // and replay them once the key exists — fresh normal servers keep the
-  // unknown-key fatal, it is a protocol violation there.
-  if (recover_mode_ &&
+  // unknown-key fatal, it is a protocol violation there. The grace is
+  // bounded: past the deadline, exit recover mode (failing anything
+  // still parked) and fall through to the fatal for this op. The lazy
+  // check suffices — a parked original never gets a reply, so its
+  // sender's retry timer keeps re-delivering it here until either its
+  // re-declare lands or the deadline trips.
+  if (recover_mode_.load(std::memory_order_relaxed) &&
       (h.cmd == CMD_PUSH || h.cmd == CMD_PULL || h.cmd == CMD_BCAST_PUSH ||
        h.cmd == CMD_BCAST_PULL || h.cmd == CMD_RESEED) &&
       GetStore(h.key) == nullptr) {
-    if (ParkUndeclared(std::move(task))) return;
+    if (NowUs() < recover_grace_end_us_) {
+      if (ParkUndeclared(std::move(task))) return;
+    } else {
+      EndReseedGrace();
+    }
   }
   // Dedup window (see KeyStore::SenderRec): applies to the per-key
   // stateful commands. INIT_KEY is naturally idempotent and skips it.
@@ -598,13 +613,26 @@ void BytePSServer::Process(EngineTask&& task) {
       KeyStore* ks = GetStore(h.key);
       BPS_CHECK(ks) << "reseed for undeclared key " << h.key;
       int slot = h.version & 1;
-      if (static_cast<int>(h.version) > ks->last_round[slot]) {
+      const int ver = static_cast<int>(h.version);
+      // Install only when the slot is not owned by a LATER round. A
+      // chaos-dropped reseed offer re-delivered by the retry timer can
+      // land after the fleet advanced to round ver+2 on the same slot
+      // parity (last_round[slot] is still -1 on a fresh replacement
+      // because round ver completed on the dead predecessor); assigning
+      // over that partial ver+2 sum would complete the round with a
+      // silently corrupted aggregate. A stale offer carries nothing the
+      // fleet still needs — per-key chaining means no worker can be
+      // parked on round ver once ver+2 pushes exist — so just ack it.
+      const bool slot_owned_by_newer =
+          ks->push_count[slot] > 0 && ks->round[slot] != ver;
+      if (ver > ks->last_round[slot] && ks->round[slot] <= ver &&
+          !slot_owned_by_newer) {
         ks->slot[slot].assign(msg.payload.begin(), msg.payload.end());
         ks->last_round[slot] = h.version;
         // The slot may already be accumulating this round from
         // recovery re-pushes that arrived first; the reseed IS that
         // round's final sum — supersede the partial accumulation.
-        if (ks->round[slot] == static_cast<int>(h.version)) {
+        if (ks->round[slot] == ver) {
           ks->round[slot] = -1;
           ks->push_count[slot] = 0;
           ks->pull_count[slot] = 0;
@@ -616,7 +644,7 @@ void BytePSServer::Process(EngineTask&& task) {
         std::vector<EngineTask> waiting;
         waiting.swap(ks->pending_pulls[slot]);
         for (auto& p : waiting) {
-          if (p.msg.head.version == static_cast<int>(h.version)) {
+          if (p.msg.head.version == ver) {
             ServeRetainedPull(ks, slot, p);
           } else {
             ks->pending_pulls[slot].push_back(std::move(p));
@@ -694,6 +722,35 @@ void BytePSServer::Process(EngineTask&& task) {
     default:
       BPS_LOG(WARNING) << "server: unexpected cmd " << h.cmd;
   }
+}
+
+void BytePSServer::EndReseedGrace() {
+  // exchange: exactly one engine thread runs the teardown.
+  if (!recover_mode_.exchange(false)) return;
+  std::unordered_map<int64_t, std::vector<EngineTask>> parked;
+  {
+    std::lock_guard<std::mutex> lk(store_mu_);
+    parked.swap(pre_declare_parked_);
+  }
+  size_t n = 0;
+  for (auto& kv : parked) {
+    for (auto& t : kv.second) {
+      SendWireError(t.fd, t.msg.head,
+                    "key " + std::to_string(kv.first) +
+                        " was never re-declared within the re-seed grace "
+                        "window (" + std::to_string(RecoveryTimeoutMs()) +
+                        " ms) — protocol violation, not a re-seed race");
+      ++n;
+    }
+  }
+  BPS_LOG(WARNING) << "server: re-seed grace ended — unknown-key fatal "
+                      "restored"
+                   << (n ? ", failed " + std::to_string(n) +
+                               " op(s) parked without a re-declare"
+                         : "");
+  // Note: the grace ending does NOT clear store_/dedup state — keys
+  // re-declared in time keep serving normally; only the park-unknown
+  // leniency is withdrawn.
 }
 
 bool BytePSServer::ParkUndeclared(EngineTask&& task) {

@@ -200,6 +200,12 @@ class BytePSServer {
   // budget fresh) and replays when its INIT_KEY arrives. Returns true
   // when the task was parked.
   bool ParkUndeclared(EngineTask&& task);
+  // End of the re-seed grace window: exit recover mode (restoring the
+  // unknown-key fatal) and fail any ops still parked without their
+  // re-declare — they would otherwise hang forever, their keepalives
+  // keeping the sender's retry budget fresh. Idempotent; safe to race
+  // from multiple engine threads.
+  void EndReseedGrace();
   void ReplayParked(KeyStore* ks, int slot);
   void ReplyBcastPull(KeyStore* ks, int fd, const MsgHeader& req);
   void ServeBcastRound(KeyStore* ks, int round, int fd,
@@ -209,8 +215,13 @@ class BytePSServer {
   bool async_ = false;
   // Replacement incarnation (DMLC_RECOVER_RANK set): data-plane ops may
   // legally arrive before their keys are re-declared — park them
-  // instead of treating an unknown key as a protocol violation.
-  bool recover_mode_ = false;
+  // instead of treating an unknown key as a protocol violation. The
+  // state is bounded: once the grace deadline passes, EndReseedGrace
+  // clears the flag and the fatal is back — a genuinely undeclared key
+  // (a real protocol bug, not a re-seed race) crashes loudly instead
+  // of hanging silently. Atomic: engine threads race the lazy expiry.
+  std::atomic<bool> recover_mode_{false};
+  int64_t recover_grace_end_us_ = 0;  // written once in Start
   std::mutex store_mu_;  // guards store_ map shape + pre_declare_parked_
   std::unordered_map<int64_t, std::unique_ptr<KeyStore>> store_;
   std::unordered_map<int64_t, std::vector<EngineTask>> pre_declare_parked_;

@@ -147,6 +147,13 @@ class BytePSWorker {
     int rec_stage = 0;
     int rec_push_rid = -1;
     PushOp rec_op;
+    // Last completed round's unscaled aggregate — the re-seed payload.
+    // Costs ~one gradient-sized buffer per worker whenever recovery is
+    // armed (documented under BYTEPS_RECOVERY_TIMEOUT_MS in
+    // docs/env.md). EVERY worker retains it, not a designated rank:
+    // the server can die after serving some ranks' round-r pulls but
+    // not others', and only a rank whose pull COMPLETED holds round
+    // r's bytes — which ranks those are is unknowable in advance.
     std::vector<char> reseed_data;
     int reseed_round = -1;
   };
