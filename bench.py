@@ -216,7 +216,26 @@ def main() -> None:
                         "'ratio' is 1.0 by construction, so its spread/CI "
                         "is the measured noise floor of the gate number "
                         "on this host — commit it next to the real run")
+    p.add_argument("--trace-overhead", action="store_true",
+                   help="ISSUE 5 acceptance artifact: comm-only "
+                        "small-tensor rounds over a real 2wx2s PS fleet "
+                        "with tracing off / flight-recorder-only (the "
+                        "new default) / full BYTEPS_TRACE_ON, quantifying "
+                        "what the always-on ring costs (<5%% gate). "
+                        "Writes --out (BENCH_trace_r06.json)")
+    p.add_argument("--out", default="",
+                   help="--trace-overhead only: artifact JSON path")
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--servers", type=int, default=2)
+    p.add_argument("--rounds", type=int, default=40,
+                   help="--trace-overhead only: timed comm rounds per "
+                        "fleet run")
+    p.add_argument("--role", default="", help=argparse.SUPPRESS)
     args = p.parse_args()
+    if args.role == "trace_overhead_worker":
+        return _trace_overhead_worker(args)
+    if args.trace_overhead:
+        return bench_trace_overhead(args)
     if args.sweep:
         args.mfu = True
         if args.repeats is None:
@@ -538,6 +557,162 @@ def bench_gpt2(args) -> None:
               metric="gpt2_124m_lm_seqs_per_sec_per_chip",
               smoke_metric="gpt2_smoke_seqs_per_sec",
               aa_metric="gpt2_aa_noise_floor")
+
+
+def _trace_overhead_worker(args) -> None:
+    """Fleet-worker body for --trace-overhead: comm-only rounds over the
+    ResNet-50 sub-64KB key set (the small-tensor population where
+    per-message costs — and therefore per-event trace emission — are the
+    largest fraction of round time; a large-tensor round would hide the
+    overhead in payload copies)."""
+    import numpy as np
+
+    from byteps_tpu.core import Worker
+    from tools.shaped_fleet import load_model_sizes
+
+    sizes = [n for n in load_model_sizes("resnet50") if n * 4 < 65536]
+    w = Worker.start()
+    tids = [w.declare(f"tr_{i}", n, "float32", compression="")
+            for i, n in enumerate(sizes)]
+    arrs = [np.ones(n, dtype=np.float32) for n in sizes]
+
+    def one_round():
+        hs = [w.push_pull(t, a, average=False)
+              for t, a in zip(tids, arrs)]
+        for h in hs:
+            w.wait(h)
+
+    for _ in range(args.warmup):
+        one_round()
+    w.barrier()
+    c0 = w.metrics_snapshot()["counters"]
+    t0 = time.perf_counter()
+    for _ in range(args.rounds):
+        one_round()
+    dt = time.perf_counter() - t0
+    w.barrier()
+    c1 = w.metrics_snapshot()["counters"]
+
+    def delta(name):
+        return int(c1.get(name, 0)) - int(c0.get(name, 0))
+
+    print(json.dumps({
+        "rank": w.worker_rank(),
+        "keys": len(sizes),
+        "rounds": args.rounds,
+        "seconds": round(dt, 4),
+        "steps_per_s": round(args.rounds / dt, 3),
+        "trace_events": delta("bps_trace_events_total"),
+        "trace_dropped": delta("bps_trace_dropped_total"),
+    }), flush=True)
+    w.shutdown()
+
+
+def bench_trace_overhead(args) -> None:
+    """A/B/C the tracing subsystem's hot-path cost on comm-only
+    small-tensor rounds (ISSUE 5 acceptance: the default-on flight
+    recorder must cost <5% vs the PR 4 baseline).
+
+      off          BYTEPS_TRACE_ON=0, BYTEPS_FLIGHT_RECORDER=0 — the
+                   PR 4 wire path byte for byte (armed checks compile
+                   to one relaxed load per site)
+      flight_only  recorder on, main ring off — the NEW DEFAULT; its
+                   emit sites are all cold-path (resends, keepalives,
+                   chaos, membership), so a healthy run records ~nothing
+      trace_on     full BYTEPS_TRACE_ON=1 — every span/instant/flow of
+                   every push (the price of a one-look fleet timeline,
+                   bounded by the drop-oldest ring; not default-on)
+
+    Configs interleave round-robin within each rep, so the three runs
+    of one rep share the host's drift conditions; the overhead numbers
+    are the MEDIAN over reps of the per-rep paired ratio off/<config>
+    (the same drift-cancelling pairing bench.py's training gate uses —
+    on this shared 1-core host the absolute steps/s swing far more
+    between reps than any config does within one). Headline steps/s
+    stay best-of, per the convention above; the full per-rep record
+    rides along so no number is read without its spread.
+    """
+    import os
+    import tempfile
+
+    from tools.shaped_fleet import run_fleet
+
+    repeats = args.repeats or 3
+    configs = {
+        "off": {"BYTEPS_TRACE_ON": "0", "BYTEPS_FLIGHT_RECORDER": "0"},
+        "flight_only": {"BYTEPS_TRACE_ON": "0",
+                        "BYTEPS_FLIGHT_RECORDER": "1"},
+        "trace_on": {"BYTEPS_TRACE_ON": "1", "BYTEPS_FLIGHT_RECORDER": "1"},
+    }
+    runs = {name: [] for name in configs}
+    with tempfile.TemporaryDirectory(prefix="bps_trace_bench_") as td:
+        for rep in range(repeats):
+            for name, env in configs.items():
+                rc, recs = run_fleet(
+                    args.workers, args.servers,
+                    [os.path.abspath(__file__), "--trace-overhead",
+                     "--role", "trace_overhead_worker",
+                     "--rounds", str(args.rounds),
+                     "--warmup", str(args.warmup)],
+                    env_extra={**env, "BYTEPS_TRACE_DIR": td,
+                               # wide-open window: every timed round
+                               # records (the worst case for trace_on)
+                               "BYTEPS_TRACE_END_STEP": str(1 << 20)})
+                if rc != 0 or len(recs) != args.workers:
+                    raise SystemExit(
+                        f"{name} rep {rep} failed rc={rc} recs={len(recs)}")
+                agg = sum(r["steps_per_s"] for r in recs) / args.workers
+                runs[name].append({
+                    "steps_per_s": round(agg, 3),
+                    "trace_events": sum(r["trace_events"] for r in recs),
+                    "trace_dropped": sum(r["trace_dropped"] for r in recs),
+                })
+                print(json.dumps({"run": name, "rep": rep,
+                                  "steps_per_s": round(agg, 3)}))
+
+    def best(name):
+        return max(r["steps_per_s"] for r in runs[name])
+
+    def overhead_pct(name):
+        ratios = sorted(
+            off["steps_per_s"] / cfg["steps_per_s"]
+            for off, cfg in zip(runs["off"], runs[name]))
+        return round((statistics.median(ratios) - 1.0) * 100, 2)
+
+    out = {
+        "what": ("tracing hot-path overhead on comm-only ResNet-50 "
+                 "sub-64KB rounds, real 2wx2s PS fleet: off (PR 4 "
+                 "baseline) vs flight-recorder-only (the always-on "
+                 "default) vs full BYTEPS_TRACE_ON; overhead = median "
+                 f"per-rep paired ratio over {repeats} interleaved "
+                 "reps (drift cancels within a rep)"),
+        "workers": args.workers, "servers": args.servers,
+        "rounds": args.rounds, "repeats": repeats,
+        "runs": runs,
+        "summary": {
+            "steps_per_s_off": best("off"),
+            "steps_per_s_flight_only": best("flight_only"),
+            "steps_per_s_trace_on": best("trace_on"),
+            "flight_recorder_overhead_pct": overhead_pct("flight_only"),
+            "trace_on_overhead_pct": overhead_pct("trace_on"),
+            "flight_overhead_under_5pct":
+                overhead_pct("flight_only") < 5.0,
+            "trace_events_per_round_on": round(
+                max(r["trace_events"] for r in runs["trace_on"])
+                / args.rounds, 1),
+        },
+    }
+    print(json.dumps({"metric": "flight_recorder_overhead_pct",
+                      "value": out["summary"][
+                          "flight_recorder_overhead_pct"],
+                      "unit": "%"}))
+    print(json.dumps({"metric": "trace_on_overhead_pct",
+                      "value": out["summary"]["trace_on_overhead_pct"],
+                      "unit": "%"}))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps({"artifact": args.out}))
 
 
 if __name__ == "__main__":

@@ -80,11 +80,23 @@ class Config:
     momentum: str = ""                    # BYTEPS_MOMENTUM ("nesterov")
     momentum_mu: float = 0.9              # BYTEPS_MOMENTUM_MU
 
-    # --- tracing (reference: BYTEPS_TRACE_*, SURVEY.md §5) -----------------
+    # --- tracing (reference: BYTEPS_TRACE_*, SURVEY.md §5; ISSUE 5) --------
     trace_on: bool = False                # BYTEPS_TRACE_ON
-    trace_dir: str = "./traces"           # BYTEPS_TRACE_DIR
+    trace_dir: str = "./traces"           # BYTEPS_TRACE_DIR (canonical);
+    #   the legacy BPS_TRACE_OUT alias is still accepted — BYTEPS_TRACE_DIR
+    #   wins when both are set (with a warning on conflict)
     trace_start_step: int = 1             # BYTEPS_TRACE_START_STEP
     trace_end_step: int = 10              # BYTEPS_TRACE_END_STEP
+    #   the step window is enforced in the C core too: once the Timeline
+    #   helper reports steps, recording stops outside [start, end]
+    trace_ring_events: int = 65536        # BYTEPS_TRACE_RING_EVENTS
+    #   main trace ring capacity (drop-oldest; overwrites are counted in
+    #   bps_trace_dropped_total and flagged TRACE-DROPPING by monitor.top)
+    flight_recorder: bool = True          # BYTEPS_FLIGHT_RECORDER
+    #   always-on bounded ring of significant events (epoch pause/resume,
+    #   resends, keepalives, chaos, failures) on EVERY role, auto-dumped
+    #   to the trace dir on fatal CHECK / failure SHUTDOWN / recovery
+    flight_recorder_events: int = 256     # BYTEPS_FLIGHT_RECORDER_EVENTS
 
     # --- live monitoring (byteps_tpu.monitor, docs/monitoring.md) ----------
     monitor_on: bool = False              # BYTEPS_MONITOR_ON
@@ -219,6 +231,25 @@ class Config:
             raise ValueError(
                 "BYTEPS_FUSION_LINGER_US must be >= 0 (microseconds the "
                 "fusion collector waits before flushing a partial batch)")
+        if self.trace_start_step < 1:
+            raise ValueError(
+                "BYTEPS_TRACE_START_STEP must be >= 1 (steps are "
+                "1-indexed; the window starts at this step)")
+        if self.trace_end_step < self.trace_start_step:
+            raise ValueError(
+                f"BYTEPS_TRACE_END_STEP ({self.trace_end_step}) must be "
+                f">= BYTEPS_TRACE_START_STEP ({self.trace_start_step}): "
+                "an inverted window records nothing and dumps an empty "
+                "timeline")
+        if self.trace_ring_events < 16:
+            raise ValueError(
+                "BYTEPS_TRACE_RING_EVENTS must be >= 16 (main trace "
+                "ring capacity, drop-oldest)")
+        if self.flight_recorder_events < 8:
+            raise ValueError(
+                "BYTEPS_FLIGHT_RECORDER_EVENTS must be >= 8 (flight "
+                "recorder ring capacity; set BYTEPS_FLIGHT_RECORDER=0 "
+                "to disable the recorder instead)")
         if self.num_worker < 1:
             raise ValueError("DMLC_NUM_WORKER must be >= 1")
         if self.ps_mode not in ("auto", "collective", "ps"):
@@ -329,6 +360,23 @@ class Config:
         return self
 
 
+def _trace_dir_from_env() -> str:
+    """Canonical trace directory: BYTEPS_TRACE_DIR, with the legacy
+    BPS_TRACE_OUT accepted as an alias (docs/timeline.md used one name,
+    the config read the other — ISSUE 5 unifies them). On conflict the
+    canonical name wins, with a warning naming both values."""
+    new = os.environ.get("BYTEPS_TRACE_DIR")
+    old = os.environ.get("BPS_TRACE_OUT")
+    if new and old and new != old:
+        import warnings
+        warnings.warn(
+            f"both BYTEPS_TRACE_DIR ({new!r}) and its legacy alias "
+            f"BPS_TRACE_OUT ({old!r}) are set and disagree; using "
+            "BYTEPS_TRACE_DIR (the canonical name — drop BPS_TRACE_OUT)",
+            stacklevel=2)
+    return new or old or "./traces"
+
+
 def load_config() -> Config:
     """Read the full configuration from the environment (one snapshot)."""
     return Config(
@@ -355,9 +403,13 @@ def load_config() -> Config:
         momentum=_env_str("BYTEPS_MOMENTUM", ""),
         momentum_mu=float(os.environ.get("BYTEPS_MOMENTUM_MU", "0.9")),
         trace_on=_env_bool("BYTEPS_TRACE_ON"),
-        trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
+        trace_dir=_trace_dir_from_env(),
         trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 1),
         trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 10),
+        trace_ring_events=_env_int("BYTEPS_TRACE_RING_EVENTS", 65536),
+        flight_recorder=_env_bool("BYTEPS_FLIGHT_RECORDER", True),
+        flight_recorder_events=_env_int("BYTEPS_FLIGHT_RECORDER_EVENTS",
+                                        256),
         monitor_on=_env_bool("BYTEPS_MONITOR_ON"),
         monitor_port=_env_int("BYTEPS_MONITOR_PORT", 9100),
         straggler_factor=float(

@@ -18,6 +18,7 @@ LIB_PATH = os.path.join(CORE_DIR, "libbyteps_core.so")
 
 SOURCES = [
     "debug.cc",
+    "trace.cc",
     "van.cc",
     "postoffice.cc",
     "cpu_reducer.cc",

@@ -19,6 +19,7 @@
 #include "metrics.h"
 #include "postoffice.h"
 #include "server.h"
+#include "trace.h"
 #include "worker.h"
 
 namespace {
@@ -183,6 +184,18 @@ int bps_init(int role) {
   }
 
   int id = gl->po->Start(gl->role, uri, port, nw, ns, std::move(handler));
+  // Fleet tracing (ISSUE 5): identity for this rank's dump metadata,
+  // plus the trace-health series pre-registered so every /metrics page
+  // serves them from zero (monitor.top's TRACE-DROPPING flag).
+  Trace::Get().SetNode(role, id,
+                       gl->role == ROLE_WORKER ? gl->po->my_worker_rank()
+                                               : -1);
+  if (gl->role == ROLE_SCHEDULER) {
+    Trace::Get().SetClock(0, 0);  // the scheduler IS the timebase
+  }
+  Metrics::Get().Counter("bps_trace_events_total");
+  Metrics::Get().Counter("bps_trace_dropped_total");
+  Metrics::Get().Counter("bps_flight_dumps_total");
   gl->inited = true;
   return id;
 }
@@ -245,26 +258,38 @@ const char* bps_last_error() {
 
 // Dump accumulated trace events as Chrome trace-event JSON (reference:
 // BYTEPS_TRACE_ON timeline, SURVEY.md §5). Returns number of events.
+// ISSUE 5: works for EVERY role (the ring is process-wide, not
+// worker-owned) and prepends a `meta` object — role, node id, and the
+// heartbeat-derived clock offset vs the scheduler — that the fleet
+// merge tool (python -m byteps_tpu.monitor.timeline) aligns ranks with.
+// Drains the ring: dump-once timeline semantics, as before.
 int bps_dump_trace(const char* path) {
-  Global* gl = g();
-  if (!gl->worker) return -1;
-  auto events = gl->worker->DrainTrace();
-  FILE* f = fopen(path, "w");
-  if (!f) return -1;
-  fprintf(f, "{\"traceEvents\":[\n");
-  int rank = gl->po->my_worker_rank();
-  for (size_t i = 0; i < events.size(); ++i) {
-    const auto& e = events[i];
-    fprintf(f,
-            "{\"name\":\"%s\",\"ph\":\"X\",\"pid\":%d,\"tid\":%lld,"
-            "\"ts\":%lld,\"dur\":%lld,\"args\":{\"key\":%lld}}%s\n",
-            e.stage, rank, static_cast<long long>(e.key),
-            static_cast<long long>(e.ts_us), static_cast<long long>(e.dur_us),
-            static_cast<long long>(e.key), i + 1 < events.size() ? "," : "");
+  return static_cast<int>(Trace::Get().DumpMain(path));
+}
+
+// Snapshot the always-on flight recorder (BYTEPS_FLIGHT_RECORDER) to
+// `path`, or to the default <BYTEPS_TRACE_DIR>/flight_r<role>_n<id>.json
+// when path is NULL/empty. Non-draining: the recorder keeps recording.
+// The same dump fires automatically on fatal CHECK, failure SHUTDOWN,
+// and recovery EPOCH_PAUSE/RESUME.
+int bps_dump_flight(const char* path) {
+  if (path && *path) {
+    return static_cast<int>(Trace::Get().DumpFlight(path));
   }
-  fprintf(f, "]}\n");
-  fclose(f);
-  return static_cast<int>(events.size());
+  return static_cast<int>(Trace::Get().FlightDumpAuto("manual"));
+}
+
+// Report the current training step for the BYTEPS_TRACE_START_STEP /
+// _END_STEP window (utils.Timeline calls this once per step). Steps
+// never reported leave the window open — raw-FFI users keep the old
+// always-recording behavior; with steps reported, recording stops
+// outside the window instead of accumulating without bound.
+void bps_trace_step(int step) { Trace::Get().SetStep(step); }
+
+// App-level annotation: record an instant into the main trace ring and
+// the flight recorder (also the test hook for ring wraparound).
+void bps_trace_note(const char* name, long long key) {
+  if (name) Trace::Get().Note(name, key);
 }
 
 // Standalone CpuReducer throughput probe: repeatedly sum a src buffer

@@ -104,6 +104,16 @@ enum Command : int32_t {
                              // round, payload = the unscaled aggregate)
                              // so pulls parked mid-round can be served
                              // from the authoritative worker replica.
+  CMD_HEARTBEAT_ACK = 25,    // scheduler -> node: echo of a heartbeat
+                             // (arg0 = the sender's original send
+                             // timestamp in steady-clock us, arg1 = the
+                             // scheduler's clock at receipt). The sender
+                             // keeps its minimum-RTT sample and derives
+                             // its clock offset vs the scheduler —
+                             // recorded in every trace dump's metadata
+                             // so the fleet timeline merge
+                             // (monitor.timeline) can align per-rank
+                             // clocks without NTP assumptions.
 };
 
 // Transient-fault tolerance: commands eligible for chaos injection,

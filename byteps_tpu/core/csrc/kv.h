@@ -23,6 +23,7 @@
 #include "logging.h"
 #include "metrics.h"
 #include "postoffice.h"
+#include "trace.h"
 
 namespace bps {
 
@@ -215,6 +216,8 @@ class KVWorker {
       // original (e.g. a pull parked behind a slow peer's push): reset
       // the attempt budget so a legitimately slow round never exhausts
       // retries — only true silence escalates to fail-stop.
+      Trace::Get().Note("KEEPALIVE", msg.head.key, msg.head.sender,
+                        msg.head.req_id);
       std::lock_guard<std::mutex> lk(mu_);
       auto it = pending_.find(msg.head.req_id);
       if (it != pending_.end() && retry_max_ > 0) {
@@ -381,6 +384,8 @@ class KVWorker {
                                  &one, r.payload.empty() ? 0 : 1);
       if (!ok) continue;
       BPS_METRIC_COUNTER_ADD("bps_retries_total", 1);
+      Trace::Get().Note("RESEND", r.head.key, r.node, r.rid,
+                        r.head.version);
       std::lock_guard<std::mutex> lk(mu_);
       auto it = pending_.find(r.rid);
       if (it == pending_.end()) continue;  // settled while resending
@@ -444,6 +449,8 @@ class KVWorker {
         std::lock_guard<std::mutex> lk(mu_);
         auto it = pending_.find(rid);
         if (it == pending_.end()) continue;
+        Trace::Get().Note("REQ_FAILED", it->second.head.key,
+                          it->second.node, rid);
         cb = std::move(it->second.cb);
         pending_.erase(it);
         done_count_++;

@@ -12,6 +12,12 @@
 
 namespace bps {
 
+// Defined in trace.cc: dump the always-on flight recorder before a
+// fatal CHECK aborts, so the crash ships with the last N events
+// (docs/troubleshooting.md "read the flight recorder first").
+// Reentrancy-guarded, dumps at most once per process.
+void FlightDumpOnFatal();
+
 enum class LogLevel : int { DEBUG = 0, INFO = 1, WARNING = 2, FATAL = 3 };
 
 inline LogLevel MinLogLevel() {
@@ -40,7 +46,12 @@ class LogMessage {
       fprintf(stderr, "%s\n", stream_.str().c_str());
       fflush(stderr);
     }
-    if (fatal_) abort();
+    if (fatal_) {
+      // Still in normal (non-signal) context here: safe to take the
+      // ring mutex and write the flight-recorder dump before aborting.
+      FlightDumpOnFatal();
+      abort();
+    }
   }
   std::ostringstream& stream() { return stream_; }
 

@@ -8,6 +8,7 @@
 
 #include "logging.h"
 #include "metrics.h"
+#include "trace.h"
 
 namespace bps {
 
@@ -136,9 +137,11 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
                          << " unreachable — parking its in-flight "
                             "requests, awaiting hot replacement";
       }
+      Trace::Get().Note("PEER_PARKED", 0, node_id);
       if (peer_paused_cb_) peer_paused_cb_(node_id);
       return;
     }
+    Trace::Get().Note("PEER_LOST", 0, node_id);
     if (peer_lost_cb_) peer_lost_cb_(node_id);
   });
 
@@ -352,6 +355,8 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
             nodes_.push_back(pr2.info);
             node_fd_[id] = pr2.fd;
             last_heartbeat_ms_[id] = NowMs();
+            // Membership event for the scheduler's timeline row.
+            Trace::Get().Instant("register", id, id, -1, pr2.info.role);
           }
           for (auto& pr2 : pending_regs_) {
             MsgHeader h{};
@@ -418,11 +423,44 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
       break;
     }
     case CMD_HEARTBEAT: {
-      std::lock_guard<std::mutex> lk(mu_);
-      // A cleanly-departed worker keeps heartbeating while it waits for
-      // the fleet shutdown; re-inserting it would later read as a death.
-      if (!departed_.count(msg.head.sender)) {
-        last_heartbeat_ms_[msg.head.sender] = NowMs();
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        // A cleanly-departed worker keeps heartbeating while it waits for
+        // the fleet shutdown; re-inserting it would later read as a death.
+        if (!departed_.count(msg.head.sender)) {
+          last_heartbeat_ms_[msg.head.sender] = NowMs();
+        }
+      }
+      // Echo for clock alignment (ISSUE 5): arg0 = the sender's send
+      // timestamp, arg1 = this (scheduler) clock now. The sender keeps
+      // its min-RTT sample and derives its offset vs our clock — the
+      // common timebase the fleet timeline merge aligns every rank to.
+      if (msg.head.arg0 > 0) {
+        MsgHeader ack{};
+        ack.cmd = CMD_HEARTBEAT_ACK;
+        ack.sender = kSchedulerId;
+        ack.arg0 = msg.head.arg0;
+        ack.arg1 = NowUs();
+        van_->Send(fd, ack);
+      }
+      break;
+    }
+    case CMD_HEARTBEAT_ACK: {
+      // Scheduler echo of our heartbeat: rtt = now - send_ts; the
+      // scheduler stamped its clock at (approximately) the midpoint, so
+      // offset = sched_ts - (send_ts + rtt/2). Keep the MINIMUM-rtt
+      // sample — queuing delay only ever inflates rtt, so the smallest
+      // sample bounds the offset error tightest (NTP's core trick).
+      int64_t now = NowUs();
+      int64_t rtt = now - msg.head.arg0;
+      if (rtt >= 0) {
+        int64_t best = clock_rtt_us_.load();
+        if (best < 0 || rtt < best) {
+          int64_t offset = msg.head.arg1 - (msg.head.arg0 + rtt / 2);
+          clock_rtt_us_.store(rtt);
+          clock_offset_us_.store(offset);
+          Trace::Get().SetClock(offset, rtt);
+        }
       }
       break;
     }
@@ -449,6 +487,11 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
       BPS_LOG(WARNING) << "node " << my_id_ << ": epoch "
                        << msg.head.arg0 << " PAUSE — server " << node
                        << " is being replaced";
+      // Flight-recorder trigger (ISSUE 5): a recovery in progress is
+      // exactly when the last N events are worth keeping — dump now so
+      // even a rank that dies mid-recovery leaves a record.
+      Trace::Get().Note("EPOCH_PAUSE", msg.head.arg0, node);
+      Trace::Get().FlightDumpAuto("epoch_pause");
       if (role_ == ROLE_WORKER && peer_paused_cb_) peer_paused_cb_(node);
       break;
     }
@@ -486,6 +529,8 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
                        << msg.head.arg0 << " RESUME — server " << node
                        << " replaced at " << info.host << ":"
                        << info.port;
+      Trace::Get().Note("EPOCH_RESUME", msg.head.arg0, node);
+      Trace::Get().FlightDumpAuto("epoch_resume");
       if (role_ == ROLE_WORKER) {
         if (dialed && peer_recovered_cb_) {
           peer_recovered_cb_(node);
@@ -526,7 +571,11 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
         // arg0 == 1 marks a FAILURE shutdown (dead-node broadcast from
         // the scheduler's heartbeat monitor) vs the clean teardown;
         // server entry points exit nonzero on it.
-        if (msg.head.arg0 == 1) failure_shutdown_.store(true);
+        if (msg.head.arg0 == 1) {
+          failure_shutdown_.store(true);
+          Trace::Get().Note("FAILURE_SHUTDOWN", 0, msg.head.sender);
+          Trace::Get().FlightDumpAuto("failure_shutdown");
+        }
         shutting_down_.store(true);
         {
           std::lock_guard<std::mutex> lk(mu_);
@@ -636,6 +685,7 @@ bool Postoffice::TryReconnect(int node_id, int stripe) {
     BPS_LOG(WARNING) << "node " << my_id_ << ": reconnected to node "
                      << node_id << " (stripe " << stripe << ", attempt "
                      << attempt + 1 << ") — resuming in-flight requests";
+    Trace::Get().Note("RECONNECT", stripe, node_id);
     return true;
   }
   BPS_LOG(WARNING) << "node " << my_id_ << ": reconnect to node "
@@ -647,6 +697,8 @@ bool Postoffice::TryReconnect(int node_id, int stripe) {
 void Postoffice::BroadcastFailureLocked(const std::string& why) {
   BPS_LOG(WARNING) << "scheduler: " << why
                    << " — broadcasting failure shutdown";
+  Trace::Get().Note("FAILURE_SHUTDOWN");
+  Trace::Get().FlightDumpAuto("failure_shutdown");
   MsgHeader h{};
   h.cmd = CMD_SHUTDOWN;
   h.sender = kSchedulerId;
@@ -661,6 +713,8 @@ void Postoffice::BroadcastFailureLocked(const std::string& why) {
 }
 
 void Postoffice::StartRecoveryLocked(int node_id) {
+  Trace::Get().Note("EPOCH_PAUSE", epoch_.load() + 1, node_id);
+  Trace::Get().FlightDumpAuto("epoch_pause");
   epoch_.fetch_add(1);
   recovering_node_ = node_id;
   recovery_deadline_ms_ = NowMs() + RecoveryTimeoutMs();
@@ -721,6 +775,7 @@ void Postoffice::HandleRecoverRegister(int fd, const NodeInfo& info,
                         "starting recovery inline";
     StartRecoveryLocked(id);
   }
+  Trace::Get().Note("RECOVER_REGISTER", rank, id);
   NodeInfo adopted = info;
   adopted.id = id;
   adopted.role = ROLE_SERVER;
@@ -759,6 +814,8 @@ void Postoffice::HandleRecoverRegister(int fd, const NodeInfo& info,
   BPS_LOG(WARNING) << "scheduler: server " << id << " hot-replaced at "
                    << adopted.host << ":" << adopted.port << " (epoch "
                    << epoch_.load() << ")";
+  Trace::Get().Note("EPOCH_RESUME", epoch_.load(), id);
+  Trace::Get().FlightDumpAuto("epoch_resume");
 }
 
 bool Postoffice::DialReplacement(int node_id, const NodeInfo& info) {
@@ -810,6 +867,7 @@ void Postoffice::HeartbeatLoop() {
     MsgHeader h{};
     h.cmd = CMD_HEARTBEAT;
     h.sender = my_id_;
+    h.arg0 = NowUs();  // echoed back for the clock-offset estimate
     int fd = -1;
     {
       std::lock_guard<std::mutex> lk(mu_);
@@ -826,6 +884,8 @@ void Postoffice::HeartbeatLoop() {
       if (!shutting_down_.load()) {
         BPS_LOG(WARNING) << "node " << my_id_
                          << ": scheduler connection lost — failure shutdown";
+        Trace::Get().Note("SCHED_CONN_LOST");
+        Trace::Get().FlightDumpAuto("scheduler_lost");
         failure_shutdown_.store(true);
         shutting_down_.store(true);
         {

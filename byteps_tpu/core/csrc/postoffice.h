@@ -121,6 +121,14 @@ class Postoffice {
 
   Van& van() { return *van_; }
   bool ShuttingDown() const { return shutting_down_.load(); }
+  // Clock alignment vs the scheduler (ISSUE 5 tracing): estimated from
+  // the heartbeat echo (CMD_HEARTBEAT_ACK) with the minimum-RTT sample
+  // kept — t_scheduler ~= t_local + ClockOffsetUs(). The scheduler's
+  // own offset is 0; rtt -1 = no estimate yet (heartbeats disabled, or
+  // none answered). Recorded in every trace dump's metadata so the
+  // fleet merge (monitor.timeline) aligns per-rank clocks.
+  int64_t ClockOffsetUs() const { return clock_offset_us_.load(); }
+  int64_t ClockRttUs() const { return clock_rtt_us_.load(); }
   // Worker/server ids the scheduler considers dead (missed heartbeats).
   std::vector<int> DeadNodes();
   // Scheduler-side heartbeat freshness: (node id, ms since last beat)
@@ -215,6 +223,10 @@ class Postoffice {
   // fall-back-to-fail-stop deadline for the replacement to arrive.
   int recovering_node_ = -1;
   int64_t recovery_deadline_ms_ = 0;
+
+  // Heartbeat-echo clock estimate (see ClockOffsetUs).
+  std::atomic<int64_t> clock_offset_us_{0};
+  std::atomic<int64_t> clock_rtt_us_{-1};
 };
 
 int64_t NowMs();

@@ -7,6 +7,7 @@
 #include "cpu_reducer.h"
 #include "logging.h"
 #include "metrics.h"
+#include "trace.h"
 #include "worker.h"  // NowUs
 
 namespace bps {
@@ -65,6 +66,11 @@ void BytePSServer::Handle(Message&& msg, int fd) {
   } else if (msg.head.cmd == CMD_PULL) {
     BPS_METRIC_COUNTER_ADD("bps_server_pull_total", 1);
   }
+  // Per-op recv instant (ISSUE 5): the gap from here to the engine's
+  // s_sum span is queueing delay inside this server — the signal that
+  // separates "engine busy" from "summation slow" in the fleet view.
+  Trace::Get().Instant("s_recv", msg.head.key, msg.head.sender,
+                       msg.head.req_id, msg.head.cmd);
   // Route by key so one key's operations are totally ordered on one thread.
   size_t tid = static_cast<size_t>(msg.head.key) % queues_.size();
   auto& eq = *queues_[tid];
@@ -104,6 +110,7 @@ void BytePSServer::HandleMulti(Message&& msg, int fd) {
   }
   BPS_METRIC_COUNTER_ADD("bps_fused_msgs_total", 1);
   BPS_METRIC_HISTO_OBSERVE("bps_fusion_batch_keys", count);
+  Trace::Get().Instant("s_recv", h.key, h.sender, h.req_id, h.cmd);
   auto batch = std::make_shared<MultiReply>();
   batch->fd = fd;
   batch->req_id = h.req_id;
@@ -478,10 +485,17 @@ void BytePSServer::Process(EngineTask&& task) {
             MarkReplied(ks, h.sender, h.req_id, ack);
             SendReply(task, ack);
           }
+          Trace::Get().Instant("s_park", h.key, h.sender, h.req_id,
+                               h.version);
           ks->parked_pushes[slot].push_back(std::move(task));
           break;
         }
       }
+      // Sum span (ISSUE 5): covers decompress + assign/sum for this
+      // push, and carries the flow step that stitches the sending
+      // worker's push span to this server's work in the merged view.
+      const int64_t t_trace =
+          Trace::Get().MainOn() ? NowUs() : 0;
       const char* data = msg.payload.data();
       int64_t data_len = static_cast<int64_t>(msg.payload.size());
       // Decompress (compressed pushes are always float32 streams).
@@ -551,6 +565,12 @@ void BytePSServer::Process(EngineTask&& task) {
           if (recycled) ReplayParked(ks, slot);
         }
       }
+      if (t_trace) {
+        Trace::Get().Span("s_sum", h.key, t_trace, NowUs(), h.sender,
+                          h.req_id, h.version);
+        Trace::Get().Flow(TRACE_FLOW_STEP, "req", h.key, t_trace,
+                          TraceFlowId(h.sender, h.req_id));
+      }
       MsgHeader ack{};
       ack.cmd = CMD_PUSH_ACK;
       ack.sender = po_->my_id();
@@ -596,6 +616,8 @@ void BytePSServer::Process(EngineTask&& task) {
           // accounting is final, so do not advance pull_count.
           ServeRetainedPull(ks, slot, task);
         } else {
+          Trace::Get().Instant("s_park", h.key, h.sender, h.req_id,
+                               h.version);
           ks->pending_pulls[slot].push_back(std::move(task));
         }
       }
@@ -612,6 +634,7 @@ void BytePSServer::Process(EngineTask&& task) {
       // idempotent.
       KeyStore* ks = GetStore(h.key);
       BPS_CHECK(ks) << "reseed for undeclared key " << h.key;
+      Trace::Get().Note("RESEED", h.key, h.sender, h.req_id, h.version);
       int slot = h.version & 1;
       const int ver = static_cast<int>(h.version);
       // Install only when the slot is not owned by a LATER round. A
@@ -727,6 +750,7 @@ void BytePSServer::Process(EngineTask&& task) {
 void BytePSServer::EndReseedGrace() {
   // exchange: exactly one engine thread runs the teardown.
   if (!recover_mode_.exchange(false)) return;
+  Trace::Get().Note("RESEED_GRACE_END");
   std::unordered_map<int64_t, std::vector<EngineTask>> parked;
   {
     std::lock_guard<std::mutex> lk(store_mu_);
@@ -754,6 +778,8 @@ void BytePSServer::EndReseedGrace() {
 }
 
 bool BytePSServer::ParkUndeclared(EngineTask&& task) {
+  Trace::Get().Note("PARK_UNDECLARED", task.msg.head.key,
+                    task.msg.head.sender, task.msg.head.req_id);
   // Keepalive first (task is moved below): the sender's retry budget
   // stays fresh while its re-declare is still in flight.
   SendKeepalive(task);
@@ -768,6 +794,7 @@ bool BytePSServer::ParkUndeclared(EngineTask&& task) {
 void BytePSServer::ServeRetainedPull(KeyStore* ks, int slot,
                                      const EngineTask& t) {
   const MsgHeader& req = t.msg.head;
+  const int64_t t_trace = Trace::Get().MainOn() ? NowUs() : 0;
   MsgHeader resp{};
   resp.cmd = CMD_PULL_RESP;
   resp.sender = po_->my_id();
@@ -792,10 +819,17 @@ void BytePSServer::ServeRetainedPull(KeyStore* ks, int slot,
     MarkReplied(ks, req.sender, req.req_id, resp);
     SendReply(t, resp, ks->slot[slot].data(), ks->slot[slot].size());
   }
+  if (t_trace) {
+    Trace::Get().Span("s_reply", req.key, t_trace, NowUs(), req.sender,
+                      req.req_id, req.version);
+    Trace::Get().Flow(TRACE_FLOW_STEP, "reply", req.key, t_trace,
+                      TraceFlowId(req.sender, req.req_id));
+  }
 }
 
 bool BytePSServer::ReplyPull(KeyStore* ks, int slot, const EngineTask& t) {
   const MsgHeader& req = t.msg.head;
+  const int64_t t_trace = Trace::Get().MainOn() ? NowUs() : 0;
   MsgHeader resp{};
   resp.cmd = CMD_PULL_RESP;
   resp.sender = po_->my_id();
@@ -817,6 +851,12 @@ bool BytePSServer::ReplyPull(KeyStore* ks, int slot, const EngineTask& t) {
                            static_cast<int64_t>(ks->slot[slot].size()));
     MarkReplied(ks, req.sender, req.req_id, resp);
     SendReply(t, resp, ks->slot[slot].data(), ks->slot[slot].size());
+  }
+  if (t_trace) {
+    Trace::Get().Span("s_reply", req.key, t_trace, NowUs(), req.sender,
+                      req.req_id, req.version);
+    Trace::Get().Flow(TRACE_FLOW_STEP, "reply", req.key, t_trace,
+                      TraceFlowId(req.sender, req.req_id));
   }
   if (++ks->pull_count[slot] == po_->num_workers()) {
     // Round fully served; recycle the slot for round r+2. The slot's

@@ -33,6 +33,7 @@
 #include "logging.h"
 #include "metrics.h"
 #include "shm_ring.h"
+#include "trace.h"
 
 namespace bps {
 
@@ -468,6 +469,7 @@ bool Van::SendV(int fd, const MsgHeader& head, const struct iovec* segs,
       // send into a dead connection (the retry layer re-issues it).
       BPS_METRIC_COUNTER_ADD("bps_chaos_injected_total", 1);
       BPS_METRIC_COUNTER_ADD("bps_chaos_reset_total", 1);
+      Trace::Get().Note("CHAOS_RESET", h.key, -1, h.req_id);
       if (VerboseLevel() >= 2) {
         fprintf(stderr, "[PS_VERBOSE] van CHAOS reset fd=%d\n", fd);
       }
@@ -485,6 +487,7 @@ bool Van::SendV(int fd, const MsgHeader& head, const struct iovec* segs,
       // under test.
       BPS_METRIC_COUNTER_ADD("bps_chaos_injected_total", 1);
       BPS_METRIC_COUNTER_ADD("bps_chaos_drop_total", 1);
+      Trace::Get().Note("CHAOS_DROP", h.key, -1, h.req_id);
       if (VerboseLevel() >= 2) {
         fprintf(stderr, "[PS_VERBOSE] van CHAOS drop fd=%d cmd=%d "
                 "seq=%lld\n", fd, h.cmd, (long long)h.seq);
@@ -494,8 +497,14 @@ bool Van::SendV(int fd, const MsgHeader& head, const struct iovec* segs,
     if (c.dup > 0 && ChaosRand(&tx->rng) < c.dup) {
       BPS_METRIC_COUNTER_ADD("bps_chaos_injected_total", 1);
       BPS_METRIC_COUNTER_ADD("bps_chaos_dup_total", 1);
+      Trace::Get().Note("CHAOS_DUP", h.key, -1, h.req_id);
       sends = 2;  // duplicate delivery, back-to-back, same seq
     }
+  }
+  // Wire instant (main ring only; one per logical send, not per chaos
+  // duplicate — the receiver's wire_recv shows the double delivery).
+  if (Trace::Get().MainOn()) {
+    Trace::Get().Instant("wire_send", h.key, -1, h.req_id, h.cmd);
   }
   bool ok = true;
   for (int send_i = 0; send_i < sends && ok; ++send_i) {
@@ -708,6 +717,10 @@ void Van::DispatchFrame(Message&& msg, int fd, int64_t* last_seq) {
     if (msg.head.seq > *last_seq) *last_seq = msg.head.seq;
   }
   LogMsg("recv", fd, msg.head, plen);
+  if (Trace::Get().MainOn()) {
+    Trace::Get().Instant("wire_recv", msg.head.key, msg.head.sender,
+                         msg.head.req_id, msg.head.cmd);
+  }
   if (msg.head.cmd == CMD_SHM_HELLO) {
     // Van-internal: the peer created a shm segment for this connection.
     // From here on the socket carries no frames; it stays open purely

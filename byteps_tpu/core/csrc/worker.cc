@@ -15,12 +15,6 @@ namespace bps {
 thread_local std::vector<BytePSWorker::PushOp>* BytePSWorker::fusion_sink_ =
     nullptr;
 
-int64_t NowUs() {
-  return std::chrono::duration_cast<std::chrono::microseconds>(
-             std::chrono::steady_clock::now().time_since_epoch())
-      .count();
-}
-
 void BytePSWorker::Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
                          int64_t credit_bytes, int64_t fusion_bytes,
                          int fusion_keys, std::string default_comp,
@@ -288,6 +282,7 @@ void BytePSWorker::RecoverServer(int node_id) {
       h.arg0 = a.p->rec_op.raw_len;
       kv_->Request(node_id, h, a.p->rec_op.payload,
                    a.p->rec_op.payload_len, nullptr);
+      Trace::Get().Note("REPUSH", a.p->key, node_id, -1, h.version);
       ++repushed;
     } else {
       h.cmd = CMD_RESEED;
@@ -295,6 +290,8 @@ void BytePSWorker::RecoverServer(int node_id) {
       kv_->Request(node_id, h, a.p->reseed_data.data(),
                    static_cast<int64_t>(a.p->reseed_data.size()),
                    nullptr);
+      Trace::Get().Note("RESEED_OFFER", a.p->key, node_id, -1,
+                        a.p->reseed_round);
       ++reseeded;
     }
   }
@@ -303,6 +300,10 @@ void BytePSWorker::RecoverServer(int node_id) {
   BPS_LOG(WARNING) << "worker: server " << node_id << " re-seeded ("
                    << repushed << " re-pushed, " << reseeded
                    << " re-seeded round(s)) — resuming";
+  // The recovery's closing flight dump: the EPOCH_PAUSE dump predates
+  // the re-seed, so refresh the file with the RESUME + reseed trail.
+  Trace::Get().Note("RECOVER_DONE", repushed + reseeded, node_id);
+  Trace::Get().FlightDumpAuto("recovery_complete");
 }
 
 void BytePSWorker::PushLoop() {
@@ -387,22 +388,10 @@ void BytePSWorker::FlushBatch(int server_id, std::vector<PushOp> ops) {
   SendFusedPush(server_id, std::move(ops));
 }
 
-void BytePSWorker::Record(int64_t key, const char* stage, int64_t start_us) {
+void BytePSWorker::Record(int64_t key, const char* stage, int64_t start_us,
+                          int peer, int32_t req_id, int32_t round) {
   if (!trace_on_) return;
-  TraceEvent ev{};
-  ev.key = key;
-  snprintf(ev.stage, sizeof(ev.stage), "%s", stage);
-  ev.ts_us = start_us;
-  ev.dur_us = NowUs() - start_us;
-  std::lock_guard<std::mutex> lk(trace_mu_);
-  trace_.push_back(ev);
-}
-
-std::vector<TraceEvent> BytePSWorker::DrainTrace() {
-  std::lock_guard<std::mutex> lk(trace_mu_);
-  std::vector<TraceEvent> out;
-  out.swap(trace_);
-  return out;
+  Trace::Get().Span(stage, key, start_us, NowUs(), peer, req_id, round);
 }
 
 int64_t BytePSWorker::Declare(const std::string& name, int64_t nelem,
@@ -554,6 +543,13 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
     };
     BPS_METRIC_COUNTER_ADD("bps_partitions_enqueued_total", 1);
     BPS_METRIC_COUNTER_ADD("bps_enqueued_bytes_total", task.bytes);
+    // Enqueue instant: the gap to this key's push span is scheduled-
+    // queue wait (credit/priority), the first stage of the merge tool's
+    // critical-path breakdown.
+    if (trace_on_) {
+      Trace::Get().Instant("enqueue", p->key, p->server_id, -1, 0,
+                           version);
+    }
     queue_->Push(std::move(task));
   }
   return handle_id;
@@ -598,7 +594,15 @@ void BytePSWorker::SendPush(PushOp op) {
         if (QueueDebug())
           fprintf(stderr, "[QDEBUG] push_ack key=%lld\n",
                   (long long)p->key);
-        Record(p->key, "push", t_push);
+        if (trace_on_) {
+          // Close the push flow at the ack, inside the push span (the
+          // span's end is recorded just after, so ts stays inside it):
+          // the merged view stitches push span -> server sum -> ack.
+          Trace::Get().Flow(TRACE_FLOW_IN, "req", p->key, NowUs(),
+                            TraceFlowId(po_->my_id(), ack.head.req_id));
+        }
+        Record(p->key, "push", t_push, p->server_id, ack.head.req_id,
+               version);
         BPS_METRIC_HISTO_OBSERVE("bps_push_us", NowUs() - t_push);
         RecTrackAck(p);
         // Async: the ack carries the server's fleet-wide apply count
@@ -613,7 +617,7 @@ void BytePSWorker::SendPush(PushOp op) {
         ph.version = version;
         ph.flags = flags & FLAG_ASYNC;
         int64_t t_pull = NowUs();
-        kv_->Request(
+        int pull_rid = kv_->Request(
             p->server_id, ph, nullptr, 0,
             [this, ctx, p, base, raw_len, version, scale, handle,
              t_pull, flags, at_push](Message&& resp) {
@@ -626,7 +630,13 @@ void BytePSWorker::SendPush(PushOp op) {
               if (QueueDebug())
                 fprintf(stderr, "[QDEBUG] pull_resp key=%lld\n",
                         (long long)p->key);
-              Record(p->key, "pull", t_pull);
+              if (trace_on_) {
+                Trace::Get().Flow(
+                    TRACE_FLOW_IN, "reply", p->key, NowUs(),
+                    TraceFlowId(po_->my_id(), resp.head.req_id));
+              }
+              Record(p->key, "pull", t_pull, p->server_id,
+                     resp.head.req_id, version);
               BPS_METRIC_HISTO_OBSERVE("bps_pull_us", NowUs() - t_pull);
               BPS_METRIC_COUNTER_ADD(
                   "bps_pull_bytes_total",
@@ -679,8 +689,19 @@ void BytePSWorker::SendPush(PushOp op) {
                 cv_.notify_all();
               }
             });
+        if (trace_on_ && pull_rid >= 0) {
+          // Open the pull's flow at its issue time (inside the pull
+          // span); the server's s_reply span carries the "t" step.
+          Trace::Get().Flow(TRACE_FLOW_OUT, "reply", p->key, t_pull,
+                            TraceFlowId(po_->my_id(), pull_rid));
+        }
       });
   RecTrackPushRid(p, push_rid);
+  if (trace_on_ && push_rid >= 0) {
+    // Open the push's flow at its issue time, inside the push span.
+    Trace::Get().Flow(TRACE_FLOW_OUT, "req", p->key, t_push,
+                      TraceFlowId(po_->my_id(), push_rid));
+  }
 }
 
 // Validate a CMD_MULTI_* reply frame and return its sub-header table;
@@ -763,6 +784,13 @@ void BytePSWorker::SendFusedPush(int server_id, std::vector<PushOp> ops) {
         OnFusedAck(server_id, batch, t_push, std::move(ack));
       },
       table_hold);
+  if (trace_on_ && push_rid >= 0) {
+    // One flow per fused frame, opened on the lead key's track; every
+    // sub-key's s_sum span on the server steps the same flow (they all
+    // share the frame's req_id).
+    Trace::Get().Flow(TRACE_FLOW_OUT, "req", h.key, t_push,
+                      TraceFlowId(po_->my_id(), push_rid));
+  }
   if (recovery_on_) {
     // One req id covers the whole frame; each sub-op records it so the
     // recovery hook can tell "frame still in the resend queue" from
@@ -788,6 +816,10 @@ void BytePSWorker::OnFusedAck(
   }
   const char* gathered = nullptr;
   const SubHeader* subs = ParseMultiReply(ack, CMD_MULTI_ACK, n, &gathered);
+  if (trace_on_) {
+    Trace::Get().Flow(TRACE_FLOW_IN, "req", (*batch)[0].p->key, NowUs(),
+                      TraceFlowId(po_->my_id(), ack.head.req_id));
+  }
   auto at_push = std::make_shared<std::vector<int64_t>>(
       static_cast<size_t>(n), 0);
   // shared_ptr table: pinned past this callback for the retry layer's
@@ -801,7 +833,8 @@ void BytePSWorker::OnFusedAck(
     if (QueueDebug())
       fprintf(stderr, "[QDEBUG] push_ack key=%lld\n",
               (long long)op.p->key);
-    Record(op.p->key, "push", t_push);
+    Record(op.p->key, "push", t_push, server_id, ack.head.req_id,
+           op.version);
     BPS_METRIC_HISTO_OBSERVE("bps_push_us", NowUs() - t_push);
     (*at_push)[i] = subs[i].arg1;  // async apply count as of our push
     SubHeader& s = table[i];
@@ -818,11 +851,16 @@ void BytePSWorker::OnFusedAck(
   h.arg0 = n;
   iovec seg{table.data(), static_cast<size_t>(n) * sizeof(SubHeader)};
   int64_t t_pull = NowUs();
-  kv_->RequestV(server_id, h, &seg, 1,
-                [this, batch, at_push, t_pull](Message&& resp) {
-                  OnFusedPullResp(batch, at_push, t_pull, std::move(resp));
-                },
-                table_hold);
+  int pull_rid = kv_->RequestV(
+      server_id, h, &seg, 1,
+      [this, batch, at_push, t_pull](Message&& resp) {
+        OnFusedPullResp(batch, at_push, t_pull, std::move(resp));
+      },
+      table_hold);
+  if (trace_on_ && pull_rid >= 0) {
+    Trace::Get().Flow(TRACE_FLOW_OUT, "reply", h.key, t_pull,
+                      TraceFlowId(po_->my_id(), pull_rid));
+  }
 }
 
 void BytePSWorker::OnFusedPullResp(
@@ -837,6 +875,11 @@ void BytePSWorker::OnFusedPullResp(
   const char* gathered = nullptr;
   const SubHeader* subs =
       ParseMultiReply(resp, CMD_MULTI_PULL_RESP, n, &gathered);
+  if (trace_on_) {
+    Trace::Get().Flow(TRACE_FLOW_IN, "reply", (*batch)[0].p->key,
+                      NowUs(),
+                      TraceFlowId(po_->my_id(), resp.head.req_id));
+  }
   int64_t gathered_len = static_cast<int64_t>(resp.payload.size()) -
                          static_cast<int64_t>(n) *
                              static_cast<int64_t>(sizeof(SubHeader));
@@ -850,7 +893,8 @@ void BytePSWorker::OnFusedPullResp(
     if (QueueDebug())
       fprintf(stderr, "[QDEBUG] pull_resp key=%lld\n",
               (long long)op.p->key);
-    Record(op.p->key, "pull", t_pull);
+    Record(op.p->key, "pull", t_pull, op.p->server_id,
+           resp.head.req_id, op.version);
     BPS_METRIC_HISTO_OBSERVE("bps_pull_us", NowUs() - t_pull);
     BPS_METRIC_COUNTER_ADD("bps_pull_bytes_total", s.len);
     if (op.flags & FLAG_ASYNC) {

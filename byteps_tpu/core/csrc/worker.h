@@ -27,16 +27,13 @@
 #include "kv.h"
 #include "postoffice.h"
 #include "scheduled_queue.h"
+#include "trace.h"
 
 namespace bps {
 
-// Chrome-trace event record (reference: BYTEPS_TRACE_* timeline, §5).
-struct TraceEvent {
-  int64_t key;
-  char stage[16];
-  int64_t ts_us;
-  int64_t dur_us;
-};
+// Trace spans (compress/push/pull + flow stitching) are recorded into
+// the process-wide rings in trace.h (ISSUE 5) — the worker is one of
+// four instrumented roles, no longer the sole owner of the timeline.
 
 class BytePSWorker {
  public:
@@ -81,8 +78,6 @@ class BytePSWorker {
 
   // Diagnostic for the most recent failed Wait on this worker.
   std::string LastError();
-
-  std::vector<TraceEvent> DrainTrace();
 
   // Scheduled-queue occupancy for the monitor snapshot: pending tasks,
   // in-flight bytes, and the credit budget they are admitted against.
@@ -171,7 +166,10 @@ class BytePSWorker {
   };
 
   void PushLoop();
-  void Record(int64_t key, const char* stage, int64_t start_us);
+  // Span into the shared main trace ring (trace.h); `round`/`peer`/`req`
+  // feed the merge tool's stage attribution and flow stitching.
+  void Record(int64_t key, const char* stage, int64_t start_us,
+              int peer = -1, int32_t req_id = -1, int32_t round = -1);
   // Mark a handle failed with the CMD_ERROR diagnostic and complete it.
   void FailHandle(const std::shared_ptr<Handle>& handle, int64_t key,
                   Message&& err);
@@ -258,11 +256,6 @@ class BytePSWorker {
   std::mutex rec_mu_;
   std::mutex rec_threads_mu_;
   std::vector<std::thread> rec_threads_;
-
-  std::mutex trace_mu_;
-  std::vector<TraceEvent> trace_;
 };
-
-int64_t NowUs();
 
 }  // namespace bps

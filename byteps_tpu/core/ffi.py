@@ -75,6 +75,14 @@ def _load() -> ctypes.CDLL:
     lib.bps_poll.restype = ctypes.c_int
     lib.bps_dump_trace.argtypes = [ctypes.c_char_p]
     lib.bps_dump_trace.restype = ctypes.c_int
+    # Fleet tracing (ISSUE 5): flight-recorder dump, step-window report,
+    # and app-level annotations — available on every role.
+    lib.bps_dump_flight.argtypes = [ctypes.c_char_p]
+    lib.bps_dump_flight.restype = ctypes.c_int
+    lib.bps_trace_step.argtypes = [ctypes.c_int]
+    lib.bps_trace_step.restype = None
+    lib.bps_trace_note.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+    lib.bps_trace_note.restype = None
     lib.bps_reducer_bench.argtypes = [ctypes.c_longlong, ctypes.c_int,
                                       ctypes.c_int]
     lib.bps_reducer_bench.restype = ctypes.c_double
@@ -152,6 +160,17 @@ def _apply_config_env(cfg: Optional[Config]) -> None:
     if cfg.compressor:
         os.environ["BYTEPS_COMPRESSOR"] = cfg.compressor
     os.environ["BYTEPS_TRACE_ON"] = "1" if cfg.trace_on else "0"
+    # Canonical trace directory (ISSUE 5): config accepts the legacy
+    # BPS_TRACE_OUT alias; the C core reads BYTEPS_TRACE_DIR for its
+    # flight-recorder auto-dumps, so project the resolved value.
+    os.environ["BYTEPS_TRACE_DIR"] = cfg.trace_dir
+    os.environ["BYTEPS_TRACE_START_STEP"] = str(cfg.trace_start_step)
+    os.environ["BYTEPS_TRACE_END_STEP"] = str(cfg.trace_end_step)
+    os.environ["BYTEPS_TRACE_RING_EVENTS"] = str(cfg.trace_ring_events)
+    os.environ["BYTEPS_FLIGHT_RECORDER"] = (
+        "1" if cfg.flight_recorder else "0")
+    os.environ["BYTEPS_FLIGHT_RECORDER_EVENTS"] = str(
+        cfg.flight_recorder_events)
     os.environ["BYTEPS_MONITOR_ON"] = "1" if cfg.monitor_on else "0"
     os.environ["BYTEPS_MONITOR_PORT"] = str(cfg.monitor_port)
     # Transient-fault tolerance + chaos harness (the C core reads these
@@ -203,9 +222,49 @@ class _Node:
             # guards every section on the inited flag.
             self._lib.bps_finalize()
             self._alive = False
+            self._maybe_autodump_trace()
             if self._monitor is not None:
                 self._monitor.stop()
                 self._monitor = None
+
+    def _maybe_autodump_trace(self) -> None:
+        """With BYTEPS_TRACE_ON, every role leaves its per-rank timeline
+        in the trace dir at shutdown (trace_r<role>_n<id>.json) — the
+        files `python -m byteps_tpu.monitor.timeline merge` gathers into
+        one fleet view. After finalize so shutdown events are included;
+        the ring (trace.h) outlives the topology."""
+        v = os.environ.get("BYTEPS_TRACE_ON", "")
+        if not v or v.strip().lower() in ("0", "false", "off", "no"):
+            return
+        try:
+            d = (os.environ.get("BYTEPS_TRACE_DIR")
+                 or os.environ.get("BPS_TRACE_OUT") or "./traces")
+            os.makedirs(d, exist_ok=True)
+            self.dump_trace(os.path.join(
+                d, f"trace_r{self.ROLE}_n{self.node_id}.json"))
+        except Exception:
+            pass  # tracing must never fail a shutdown
+
+    # --- fleet tracing (ISSUE 5; docs/timeline.md) — every role -------
+    def dump_trace(self, path: str) -> int:
+        """Drain the main trace ring into a Chrome-trace JSON (with a
+        `meta` object carrying role/node id and the clock offset vs the
+        scheduler). Returns the event count."""
+        return int(self._lib.bps_dump_trace(path.encode()))
+
+    def dump_flight(self, path: Optional[str] = None) -> int:
+        """Snapshot the always-on flight recorder (non-draining); None
+        writes the default <trace_dir>/flight_r<role>_n<id>.json."""
+        return int(self._lib.bps_dump_flight(
+            path.encode() if path else None))
+
+    def trace_step(self, step: int) -> None:
+        """Report the training step for the trace window enforcement."""
+        self._lib.bps_trace_step(int(step))
+
+    def trace_note(self, name: str, key: int = 0) -> None:
+        """App-level instant into the trace + flight rings."""
+        self._lib.bps_trace_note(name.encode(), int(key))
 
     # Scheduler/Server block here until the fleet shuts down.
     run = shutdown
@@ -296,9 +355,6 @@ class Worker(_Node):
         if rc < 0:
             self.wait(handle)  # reaps and raises with the error string
         return bool(rc)
-
-    def dump_trace(self, path: str) -> int:
-        return int(self._lib.bps_dump_trace(path.encode()))
 
     def net_bytes(self) -> tuple:
         """Cumulative (sent, received) DCN wire bytes through this

@@ -291,6 +291,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "fleet (BYTEPS_FUSION_BYTES): partitions under N "
                         "raw bytes coalesce into multi-key wire frames; "
                         "0 disables fusion (default: inherit env, 65536)")
+    p.add_argument("--trace-dir", metavar="DIR", default="",
+                   help="arm fleet-wide distributed tracing "
+                        "(BYTEPS_TRACE_ON=1, BYTEPS_TRACE_DIR=DIR): "
+                        "every role — scheduler, servers, workers — "
+                        "leaves a clock-aligned per-rank dump in DIR at "
+                        "shutdown; merge with `python -m "
+                        "byteps_tpu.monitor.timeline merge --dir DIR` "
+                        "(docs/timeline.md). Flight-recorder auto-dumps "
+                        "land in the same directory")
     p.add_argument("--supervise", type=int, metavar="N", default=0,
                    help="--local mode: per-child supervision — respawn a "
                         "dead SERVER role (up to N times total) as a hot "
@@ -328,6 +337,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.monitor_port:
         os.environ["BYTEPS_MONITOR_ON"] = "1"
         os.environ["BYTEPS_MONITOR_PORT"] = str(args.monitor_port)
+    if args.trace_dir:
+        os.environ["BYTEPS_TRACE_ON"] = "1"
+        os.environ["BYTEPS_TRACE_DIR"] = args.trace_dir
+        print(f"bpslaunch: fleet tracing on — per-rank dumps land in "
+              f"{args.trace_dir}; merge with `python -m "
+              f"byteps_tpu.monitor.timeline merge --dir "
+              f"{args.trace_dir}`", file=sys.stderr)
     if args.fusion_bytes >= 0:
         os.environ["BYTEPS_FUSION_BYTES"] = str(args.fusion_bytes)
     if args.chaos:

@@ -95,6 +95,11 @@ def analyze(scrapes: Dict[str, Optional[dict]],
             # re-seeded, and whether one is in progress right now.
             "recoveries": int(_sample(m, "bps_recoveries_total")),
             "recovering": bool(_sample(m, "bps_recovering")),
+            # Trace health (ISSUE 5): drop-oldest overwrites in the main
+            # trace ring mean the timeline is missing events — raise
+            # BYTEPS_TRACE_RING_EVENTS or narrow the step window.
+            "trace_dropped": int(_sample(m, "bps_trace_dropped_total")),
+            "flight_dumps": int(_sample(m, "bps_flight_dumps_total")),
         }
 
     # A worker actively riding the retry layer is flagged separately
@@ -102,6 +107,8 @@ def analyze(scrapes: Dict[str, Optional[dict]],
     # connection quality is not.
     retrying = sorted(n for n, w in workers.items()
                       if w["retries"] > 0 or w["reconnects"] > 0)
+    trace_dropping = sorted(n for n, w in workers.items()
+                            if w["trace_dropped"] > 0)
 
     stragglers: List[str] = []
     active = {n: w["push_mean_us"] for n, w in workers.items()
@@ -137,6 +144,7 @@ def analyze(scrapes: Dict[str, Optional[dict]],
         "baseline_push_us": baseline_us,
         "stragglers": sorted(stragglers),
         "retrying": retrying,
+        "trace_dropping": trace_dropping,
         "stale_nodes": sorted(stale_nodes),
         "dead_nodes": sorted(dead_nodes),
         "unreachable": sorted(n for n, m in scrapes.items() if m is None),
@@ -167,6 +175,8 @@ def _print_report(report: dict, as_json: bool) -> None:
             flags.append("STRAGGLER")
         if name in report.get("retrying", []):
             flags.append("RETRYING")
+        if name in report.get("trace_dropping", []):
+            flags.append("TRACE-DROPPING")
         if w.get("recovering"):
             flags.append("RECOVERING")
         elif w.get("recoveries"):

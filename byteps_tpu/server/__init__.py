@@ -40,6 +40,15 @@ def main() -> None:
     # Start() returns once the topology is up; shutdown() blocks until the
     # scheduler broadcasts fleet shutdown (worker goodbyes all received).
     node.shutdown()
+    if recover_rank and role == "server":
+        # A replacement incarnation ran a recovery: none of the
+        # automatic flight-dump triggers (EPOCH_PAUSE/RESUME land on the
+        # OTHER ranks) fire here, so leave the re-seed trail — parked
+        # ops, RESEEDs, grace events — at clean exit (ISSUE 5).
+        try:
+            node.dump_flight()
+        except Exception:
+            pass
     # A FAILURE-triggered shutdown (dead-node broadcast / lost scheduler
     # connection) exits nonzero so a supervisor can tell crash from
     # completion. The scheduler itself stays 0 — detecting and

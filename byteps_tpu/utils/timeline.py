@@ -56,12 +56,25 @@ class Timeline:
         if not self._enabled or self._dumped:
             return
         self._step += 1
+        # Report the step to the C core so its ring enforces the
+        # BYTEPS_TRACE_START_STEP/END_STEP window too — a core-only
+        # long run no longer records outside the window (ISSUE 5).
+        self._report_core_step(self._step)
         if (self._step >= self._cfg.trace_start_step
                 and not self._profiling and self._device_trace
                 and self._step < self._cfg.trace_end_step):
             self._start_device_trace()
         if self._step >= self._cfg.trace_end_step:
             self.close()
+
+    @staticmethod
+    def _report_core_step(step: int) -> None:
+        try:
+            import byteps_tpu.core.ffi as ffi
+            if ffi._lib is not None:  # never trigger a core build here
+                ffi._lib.bps_trace_step(int(step))
+        except Exception:
+            pass  # collective-mode runs have no C core; tracing is soft
 
     def close(self) -> None:
         """Dump both trace sources and the combined timeline (idempotent)."""

@@ -14,6 +14,12 @@ from byteps_tpu.core import Worker
 from byteps_tpu.core.ffi import GROUP_WORKERS
 
 
+def _trace_dir() -> str:
+    """Canonical name first, legacy alias second (ISSUE 5 env unify)."""
+    return (os.environ.get("BYTEPS_TRACE_DIR")
+            or os.environ["BPS_TRACE_OUT"])
+
+
 def main() -> int:
     mode = os.environ.get("BPS_TEST_MODE", "basic")
     if mode == "jax_train":
@@ -130,7 +136,7 @@ def main() -> int:
             w.wait(h2)
             np.testing.assert_allclose(big, float(nw))
             np.testing.assert_allclose(small, float(nw))
-            path = os.path.join(os.environ["BPS_TRACE_OUT"],
+            path = os.path.join(_trace_dir(),
                                 f"credit_rank{rank}.json")
             assert w.dump_trace(path) > 0
             with open(path) as f:
@@ -186,7 +192,7 @@ def main() -> int:
                 w.wait(h_plug)
                 w.wait(h_late)
                 w.wait(h_early)
-            path = os.path.join(os.environ["BPS_TRACE_OUT"],
+            path = os.path.join(_trace_dir(),
                                 f"prio_rank{rank}.json")
             assert w.dump_trace(path) > 0
             with open(path) as f:
@@ -382,7 +388,7 @@ def main() -> int:
             arr = np.ones(1 << 16, dtype=np.float32)
             h = w.push_pull(tid, arr, average=False)
             w.wait(h)
-            path = os.path.join(os.environ["BPS_TRACE_OUT"],
+            path = os.path.join(_trace_dir(),
                                 f"trace_rank{rank}.json")
             n = w.dump_trace(path)
             assert n > 0, "no trace events recorded"
@@ -717,6 +723,44 @@ def main() -> int:
                     "bps_reconnects_total", 0),
                 "chaos_injected": snap["counters"].get(
                     "bps_chaos_injected_total", 0),
+            }), flush=True)
+            w.barrier(GROUP_WORKERS)
+
+        elif mode == "trace_fleet":
+            # Fleet-tracing acceptance (ISSUE 5): a multi-round small-
+            # tensor run with BYTEPS_TRACE_ON=1. Every role auto-dumps
+            # its per-rank timeline at shutdown; the parent test merges
+            # them (monitor.timeline) and checks flow stitching + that
+            # the critical-path stage totals agree with this worker's
+            # /metrics histograms, printed here from the same registry.
+            import json
+            sizes = [64, 128, 256, 512, 1024, 2048] * 4  # 24 tensors
+            tids = [w.declare(f"tf{i}", n, "float32", compression="")
+                    for i, n in enumerate(sizes)]
+            for rnd in range(3):
+                staged = []
+                for i, (tid, n) in enumerate(zip(tids, sizes)):
+                    base = (np.arange(n) % 31 + i + rnd + 1).astype(
+                        np.float32)
+                    arr = np.ascontiguousarray(base * (rank + 1))
+                    staged.append((w.push_pull(tid, arr, average=False),
+                                   arr, base))
+                scale = sum(r + 1 for r in range(nw))
+                for h, arr, base in staged:
+                    w.wait(h)
+                    np.testing.assert_array_equal(arr, base * scale)
+            w.barrier(GROUP_WORKERS)  # all histograms final
+            snap = w.metrics_snapshot()
+            histos = snap["histograms"]
+            print(json.dumps({
+                "node_id": snap["node"]["id"],
+                "push_us_sum": histos["bps_push_us"]["sum"],
+                "push_count": histos["bps_push_us"]["count"],
+                "pull_us_sum": histos["bps_pull_us"]["sum"],
+                "trace_events": snap["counters"].get(
+                    "bps_trace_events_total", 0),
+                "trace_dropped": snap["counters"].get(
+                    "bps_trace_dropped_total", 0),
             }), flush=True)
             w.barrier(GROUP_WORKERS)
 

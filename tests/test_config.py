@@ -192,6 +192,57 @@ def test_recovery_requires_retry_implicitly():
                retry_max=0).validate()
 
 
+def test_trace_dir_env_unification(monkeypatch):
+    """ISSUE 5 satellite: BYTEPS_TRACE_DIR is canonical, the legacy
+    BPS_TRACE_OUT still works as an alias, and a conflicting pair warns
+    with the canonical name winning."""
+    monkeypatch.delenv("BYTEPS_TRACE_DIR", raising=False)
+    monkeypatch.delenv("BPS_TRACE_OUT", raising=False)
+    assert load_config().trace_dir == "./traces"
+    monkeypatch.setenv("BPS_TRACE_OUT", "/tmp/legacy")
+    assert load_config().trace_dir == "/tmp/legacy"
+    monkeypatch.setenv("BYTEPS_TRACE_DIR", "/tmp/canonical")
+    with pytest.warns(UserWarning, match="BPS_TRACE_OUT"):
+        cfg = load_config()
+    assert cfg.trace_dir == "/tmp/canonical"
+    # Agreeing values: no warning, no ambiguity.
+    monkeypatch.setenv("BPS_TRACE_OUT", "/tmp/canonical")
+    assert load_config().trace_dir == "/tmp/canonical"
+
+
+def test_trace_window_and_ring_validation():
+    """ISSUE 5 satellite: the step window must be well-formed (the C
+    core enforces it now too), and the ring capacities have floors."""
+    with pytest.raises(ValueError, match="BYTEPS_TRACE_END_STEP"):
+        Config(trace_start_step=10, trace_end_step=5).validate()
+    with pytest.raises(ValueError, match="BYTEPS_TRACE_START_STEP"):
+        Config(trace_start_step=0).validate()
+    with pytest.raises(ValueError, match="BYTEPS_TRACE_RING_EVENTS"):
+        Config(trace_ring_events=4).validate()
+    with pytest.raises(ValueError, match="BYTEPS_FLIGHT_RECORDER_EVENTS"):
+        Config(flight_recorder_events=2).validate()
+    Config(trace_start_step=3, trace_end_step=3).validate()  # 1-step ok
+
+
+def test_flight_recorder_defaults_and_env(monkeypatch):
+    """The flight recorder is ON by default (zero-config failure
+    forensics); BYTEPS_FLIGHT_RECORDER=0 is the off switch."""
+    for var in ("BYTEPS_FLIGHT_RECORDER", "BYTEPS_FLIGHT_RECORDER_EVENTS",
+                "BYTEPS_TRACE_RING_EVENTS"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = load_config()
+    assert cfg.flight_recorder is True
+    assert cfg.flight_recorder_events == 256
+    assert cfg.trace_ring_events == 65536
+    monkeypatch.setenv("BYTEPS_FLIGHT_RECORDER", "0")
+    monkeypatch.setenv("BYTEPS_FLIGHT_RECORDER_EVENTS", "64")
+    monkeypatch.setenv("BYTEPS_TRACE_RING_EVENTS", "1024")
+    cfg = load_config()
+    assert cfg.flight_recorder is False
+    assert cfg.flight_recorder_events == 64
+    assert cfg.trace_ring_events == 1024
+
+
 def test_recovery_env_roundtrip(monkeypatch):
     monkeypatch.setenv("DMLC_ROLE", "server")
     monkeypatch.setenv("DMLC_NUM_SERVER", "2")

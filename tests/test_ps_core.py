@@ -76,7 +76,7 @@ def test_byte_credit_bounds_inflight(tmp_path):
                  extra={"BYTEPS_PARTITION_BYTES": "65536",
                         "BYTEPS_SCHEDULING_CREDIT": "131072",
                         "BYTEPS_TRACE_ON": "1",
-                        "BPS_TRACE_OUT": str(tmp_path)})
+                        "BYTEPS_TRACE_DIR": str(tmp_path)})
 
 
 def test_priority_preemption(tmp_path):
@@ -89,7 +89,7 @@ def test_priority_preemption(tmp_path):
                         "BYTEPS_SCHEDULING_CREDIT": "65536",
                         "BYTEPS_FORCE_DISTRIBUTED": "1",
                         "BYTEPS_TRACE_ON": "1",
-                        "BPS_TRACE_OUT": str(tmp_path)})
+                        "BYTEPS_TRACE_DIR": str(tmp_path)})
 
 
 def test_fifo_mode_disables_preemption(tmp_path):
@@ -103,7 +103,7 @@ def test_fifo_mode_disables_preemption(tmp_path):
                         "BYTEPS_SCHEDULING": "fifo",
                         "BYTEPS_FORCE_DISTRIBUTED": "1",
                         "BYTEPS_TRACE_ON": "1",
-                        "BPS_TRACE_OUT": str(tmp_path)})
+                        "BYTEPS_TRACE_DIR": str(tmp_path)})
 
 
 def test_deep_pipelining_one_tensor():
@@ -342,6 +342,8 @@ def test_fusion_deep_pipeline_parked_acks():
 
 
 def test_trace_timeline(tmp_path):
+    # Deliberately uses the LEGACY BPS_TRACE_OUT alias: it must keep
+    # working end-to-end (BYTEPS_TRACE_DIR is canonical; ISSUE 5).
     run_topology(1, 1, WORKER, mode="trace",
                  extra={"BYTEPS_TRACE_ON": "1",
                         "BPS_TRACE_OUT": str(tmp_path),

@@ -1,0 +1,244 @@
+"""Unit tests for the fleet timeline merge tool (ISSUE 5) and the
+trace/flight rings' drop-oldest semantics.
+
+The merge tests are pure-Python (synthetic per-rank dumps with skewed
+clocks); the ring tests exercise the C core in a subprocess so the
+capacity env vars are read fresh (the ring is a process singleton).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from byteps_tpu.monitor.timeline import (check_flows, critical_path,
+                                         load_dump, merge_dumps)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _span(name, pid, key, ts, dur, peer=-1, req=-1, round_=-1):
+    return {"name": name, "ph": "X", "pid": pid, "tid": key, "ts": ts,
+            "dur": dur,
+            "args": {"key": key, "peer": peer, "req": req,
+                     "round": round_}}
+
+
+def _instant(name, pid, key, ts, round_=-1):
+    return {"name": name, "ph": "i", "s": "t", "pid": pid, "tid": key,
+            "ts": ts, "args": {"key": key, "peer": -1, "req": -1,
+                               "round": round_, "aux": 0}}
+
+
+def _flow(name, ph, pid, key, ts, fid):
+    e = {"name": name, "cat": "bps", "ph": ph, "id": fid, "pid": pid,
+         "tid": key, "ts": ts}
+    if ph == "f":
+        e["bp"] = "e"
+    return e
+
+
+def _dump(role, node_id, offset_us, events, worker_rank=-1, rtt_us=100):
+    return {"meta": {"ring": "trace", "role": role, "node_id": node_id,
+                     "worker_rank": worker_rank,
+                     "clock_offset_us": offset_us,
+                     "clock_rtt_us": rtt_us, "events_total": len(events),
+                     "dropped": 0, "reason": ""},
+            "traceEvents": events}
+
+
+def test_merge_applies_skewed_clock_offsets_monotone(tmp_path):
+    """Two ranks whose local clocks disagree by milliseconds: after the
+    merge applies each rank's offset, the fleet ordering is the TRUE
+    causal ordering (worker push physically before server sum), and the
+    merged stream is timestamp-sorted."""
+    # Worker's clock runs 10 ms behind the scheduler: offset +10000.
+    worker = _dump(2, 3, 10_000, [
+        _span("push", 3, 7, ts=1_000, dur=500, peer=1, req=42, round_=0),
+    ], worker_rank=0)
+    # Server's clock runs 5 ms ahead: offset -5000. Its sum happened
+    # (in scheduler time) 200us after the worker's push started.
+    server = _dump(1, 1, -5_000, [
+        _span("s_sum", 1, 7, ts=16_200, dur=100, peer=3, req=42,
+              round_=0),
+    ])
+    merged = merge_dumps([worker, server],
+                         out_path=str(tmp_path / "fleet.json"))
+    evs = [e for e in merged["traceEvents"] if "ts" in e]
+    assert [e["name"] for e in evs] == ["push", "s_sum"]
+    assert evs[0]["ts"] == 11_000  # 1_000 + 10_000
+    assert evs[1]["ts"] == 11_200  # 16_200 - 5_000
+    # Monotone: sorted by aligned timestamp.
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+    # Each rank became its own labelled process row.
+    names = {e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "M"}
+    assert names == {"worker 0 (node 3)", "server (node 1)"}
+    # The artifact on disk is valid JSON with the Chrome trace shape.
+    with open(tmp_path / "fleet.json") as f:
+        loaded = json.load(f)
+    assert isinstance(loaded["traceEvents"], list)
+    for e in loaded["traceEvents"]:
+        assert "name" in e and "ph" in e and "pid" in e
+
+
+def test_merge_flow_pairs_balanced_and_dangling():
+    fid = (3 << 40) | 42
+    worker = _dump(2, 3, 0, [
+        _span("push", 3, 7, ts=100, dur=400, peer=1, req=42),
+        _flow("req", "s", 3, 7, 100, fid),
+        _flow("req", "f", 3, 7, 490, fid),
+        # A dangling start (its ack was ring-dropped on another rank).
+        _flow("req", "s", 3, 8, 600, fid + 1),
+    ], worker_rank=0)
+    server = _dump(1, 1, 0, [
+        _flow("req", "t", 1, 7, 300, fid),
+    ])
+    stats = check_flows(merge_dumps([worker, server]))
+    assert stats["flows"] == 2
+    assert stats["balanced"] == 1
+    assert stats["unbalanced"] == 1
+
+
+def test_critical_path_stage_attribution():
+    """queue = enqueue->push gap; wire_ack = push span minus its matched
+    server sum (join on (worker node, req, key) — the flow-id pair)."""
+    worker = _dump(2, 3, 0, [
+        _instant("enqueue", 3, 7, ts=0, round_=0),
+        _span("push", 3, 7, ts=10, dur=100, peer=1, req=42, round_=0),
+        _span("pull", 3, 7, ts=120, dur=50, peer=1, req=43, round_=0),
+        _span("compress", 3, 7, ts=5, dur=4),
+    ], worker_rank=0)
+    server = _dump(1, 1, 0, [
+        _span("s_sum", 1, 7, ts=40, dur=30, peer=3, req=42, round_=0),
+        _span("s_reply", 1, 7, ts=140, dur=5, peer=3, req=43, round_=0),
+    ])
+    report = critical_path([worker, server])
+    fleet = report["fleet_stages_us"]
+    assert fleet["queue"] == 10
+    assert fleet["push"] == 100
+    assert fleet["server_sum"] == 30
+    assert fleet["wire_ack"] == 70  # 100 - 30
+    assert fleet["pull"] == 50
+    assert fleet["compress"] == 4
+    srv = report["per_server"]["server (node 1)"]
+    assert srv == {"s_sum": 30, "s_reply": 5}
+    # Per-round grouping carries the same numbers for round 0.
+    assert report["per_round"][0]["push"] == 100
+
+
+def test_straggler_attribution_low_median_rule():
+    """Same rule as monitor.top: flagged when mean push latency exceeds
+    factor x the fleet low-median, above the 1 ms floor."""
+    fast = _dump(2, 3, 0, [
+        _span("push", 3, 7, ts=0, dur=2_000, peer=1, req=1),
+    ], worker_rank=0)
+    slow = _dump(2, 4, 0, [
+        _span("push", 4, 8, ts=0, dur=9_000, peer=1, req=1),
+    ], worker_rank=1)
+    report = critical_path([fast, slow], straggler_factor=2.0)
+    assert report["stragglers"] == ["worker 1 (node 4)"]
+    assert report["baseline_push_us"] == 2_000
+
+
+def test_load_dump_tolerates_meta_less_files(tmp_path):
+    """Older dumps (pre-ISSUE-5) had no meta object; the loader supplies
+    an empty one so the merge treats them as offset-0 ranks."""
+    p = tmp_path / "old.json"
+    p.write_text(json.dumps({"traceEvents": [
+        {"name": "push", "ph": "X", "pid": 0, "tid": 1, "ts": 5,
+         "dur": 2, "args": {"key": 1}}]}))
+    d = load_dump(str(p))
+    merged = merge_dumps([d])
+    evs = [e for e in merged["traceEvents"] if "ts" in e]
+    assert evs[0]["ts"] == 5  # no offset applied
+
+
+def _run_core_script(script, env_extra):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra)
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    return out.stdout
+
+
+def test_flight_ring_wraparound_and_drop_counter(tmp_path):
+    """20 notes through an 8-slot flight ring: the dump holds exactly
+    the LAST 8 (drop-oldest) and meta.dropped counts the 12 overwritten."""
+    path = str(tmp_path / "flight.json")
+    _run_core_script(
+        "from byteps_tpu.core import ffi\n"
+        "lib = ffi._load()\n"
+        "for i in range(20):\n"
+        "    lib.bps_trace_note(f'note{i}'.encode(), i)\n"
+        f"assert lib.bps_dump_flight({path!r}.encode()) == 8\n",
+        {"BYTEPS_FLIGHT_RECORDER_EVENTS": "8"})
+    with open(path) as f:
+        d = json.load(f)
+    assert d["meta"]["ring"] == "flight"
+    assert d["meta"]["events_total"] == 20
+    assert d["meta"]["dropped"] == 12
+    names = [e["name"] for e in d["traceEvents"]]
+    assert names == [f"note{i}" for i in range(12, 20)]
+
+
+def test_flight_recorder_disabled_records_nothing(tmp_path):
+    path = str(tmp_path / "flight.json")
+    _run_core_script(
+        "from byteps_tpu.core import ffi\n"
+        "lib = ffi._load()\n"
+        "for i in range(5):\n"
+        "    lib.bps_trace_note(b'x', i)\n"
+        f"assert lib.bps_dump_flight({path!r}.encode()) == 0\n",
+        {"BYTEPS_FLIGHT_RECORDER": "0"})
+
+
+def test_main_ring_wraparound_counts_dropped_in_metrics(tmp_path):
+    """Main-ring overwrites surface in bps_trace_dropped_total — the
+    counter behind monitor.top's TRACE-DROPPING flag."""
+    path = str(tmp_path / "trace.json")
+    out = _run_core_script(
+        "from byteps_tpu.core import ffi\n"
+        "lib = ffi._load()\n"
+        "for i in range(30):\n"
+        "    lib.bps_trace_note(f'n{i}'.encode(), i)\n"
+        f"n = lib.bps_dump_trace({path!r}.encode())\n"
+        "assert n == 16, n\n"
+        "snap = ffi.metrics_snapshot()\n"
+        "print(snap['counters']['bps_trace_events_total'],\n"
+        "      snap['counters']['bps_trace_dropped_total'])\n",
+        {"BYTEPS_TRACE_ON": "1", "BYTEPS_TRACE_RING_EVENTS": "16",
+         "BYTEPS_FLIGHT_RECORDER": "0"})
+    total, dropped = out.split()
+    assert int(total) == 30
+    assert int(dropped) == 14
+    with open(path) as f:
+        d = json.load(f)
+    assert [e["name"] for e in d["traceEvents"]] == \
+        [f"n{i}" for i in range(14, 30)]
+
+
+def test_step_window_enforced_in_core(tmp_path):
+    """BYTEPS_TRACE_START_STEP/END_STEP now gate the C ring: once steps
+    are reported past the window, the main ring stops recording (a
+    core-only user tracing a long run no longer accumulates without
+    bound); steps never reported keep the old always-record behavior."""
+    path = str(tmp_path / "trace.json")
+    _run_core_script(
+        "from byteps_tpu.core import ffi\n"
+        "lib = ffi._load()\n"
+        "lib.bps_trace_note(b'before', 0)\n"   # step unknown: recorded
+        "lib.bps_trace_step(2)\n"              # inside [1, 3]
+        "lib.bps_trace_note(b'inside', 0)\n"
+        "lib.bps_trace_step(7)\n"              # past END_STEP
+        "lib.bps_trace_note(b'outside', 0)\n"
+        f"n = lib.bps_dump_trace({path!r}.encode())\n"
+        "assert n == 2, n\n",
+        {"BYTEPS_TRACE_ON": "1", "BYTEPS_TRACE_START_STEP": "1",
+         "BYTEPS_TRACE_END_STEP": "3", "BYTEPS_FLIGHT_RECORDER": "0"})
+    with open(path) as f:
+        names = [e["name"] for e in json.load(f)["traceEvents"]]
+    assert names == ["before", "inside"]

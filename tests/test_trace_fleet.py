@@ -1,0 +1,243 @@
+"""Fleet-wide distributed tracing integration tests (ISSUE 5).
+
+Acceptance:
+- a 2w x 2s run with BYTEPS_TRACE_ON=1 leaves per-rank dumps for ALL
+  FOUR roles that `monitor.timeline merge` combines into one valid
+  Perfetto trace, with at least one push's worker span flow-linked to
+  its server's sum span, and critical-path stage totals within 10% of
+  the same run's /metrics stage histograms;
+- a kill-one-server recovery run auto-dumps flight-recorder rings on
+  every rank with ZERO config beyond defaults, and the merged flight
+  view shows the EPOCH_PAUSE -> RESUME -> re-seed sequence.
+
+Run the selection alone with `pytest tests/test_trace_fleet.py`.
+"""
+
+import json
+import os
+import re
+import time
+
+import pytest
+
+from tests.ps_utils import (free_port, run_topology, spawn_role,
+                            spawn_worker, topology_env)
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_ps_worker.py")
+
+pytestmark = [pytest.mark.ps]
+
+
+def test_fleet_trace_all_roles_merge_and_critical_path(tmp_path):
+    outs = run_topology(2, 2, WORKER, mode="trace_fleet",
+                        extra={"BYTEPS_TRACE_ON": "1",
+                               "BYTEPS_TRACE_DIR": str(tmp_path)},
+                        timeout=120.0)
+    rows = [json.loads(ln) for o in outs for ln in o.splitlines()
+            if ln.startswith("{")]
+    assert len(rows) == 2, outs
+    assert all(r["trace_dropped"] == 0 for r in rows), rows
+
+    # Every role auto-dumped at shutdown: 1 scheduler + 2 servers +
+    # 2 workers (role in the filename: r0/r1/r2).
+    files = sorted(os.path.basename(str(p))
+                   for p in tmp_path.glob("trace_r*_n*.json"))
+    roles = [re.match(r"trace_r(\d)_n(\d+)\.json", f).group(1)
+             for f in files]
+    assert sorted(roles) == ["0", "1", "1", "2", "2"], files
+
+    from byteps_tpu.monitor.timeline import (check_flows, critical_path,
+                                             gather, merge_dumps)
+    dumps = gather(str(tmp_path))
+    assert len(dumps) == 5
+
+    # Clock metadata: every non-scheduler rank got a heartbeat-echo
+    # offset estimate (rtt >= 0); the scheduler is the 0-offset anchor.
+    for d in dumps:
+        meta = d["meta"]
+        assert meta["clock_rtt_us"] >= 0, meta
+        if meta["role"] == 0:
+            assert meta["clock_offset_us"] == 0
+
+    out = str(tmp_path / "fleet.json")
+    merged = merge_dumps(dumps, out_path=out)
+    with open(out) as f:
+        loaded = json.load(f)  # valid JSON, Chrome/Perfetto shape
+    assert isinstance(loaded["traceEvents"], list)
+
+    # All four roles contributed events to the merged view.
+    pid_role = {d["meta"]["node_id"]: d["meta"]["role"] for d in dumps}
+    pids_with_events = {e["pid"] for e in merged["traceEvents"]
+                        if "ts" in e}
+    assert {pid_role[p] for p in pids_with_events} == {0, 1, 2}, \
+        pids_with_events
+
+    # Flow stitching: at least one push flow ("req") starts on a WORKER
+    # pid, steps through a SERVER pid (the sum span), and closes back on
+    # the worker (the ack) — the cross-rank attribution the worker-only
+    # timeline could not draw.
+    flows = {}
+    for e in merged["traceEvents"]:
+        if e.get("ph") in ("s", "t", "f") and e.get("name") == "req":
+            flows.setdefault(e["id"], {})[e["ph"]] = e["pid"]
+    stitched = [fid for fid, phs in flows.items()
+                if pid_role.get(phs.get("s")) == 2
+                and pid_role.get(phs.get("t")) == 1
+                and pid_role.get(phs.get("f")) == 2]
+    assert stitched, flows
+    stats = check_flows(merged)
+    assert stats["balanced"] >= 1
+
+    # Critical-path totals agree with the SAME run's /metrics stage
+    # histograms (the spans and the histogram observe the same
+    # measurements) — the 10% acceptance bound.
+    report = critical_path(dumps)
+    ns = 2
+    for row in rows:
+        wrank = row["node_id"] - 1 - ns
+        label = f"worker {wrank} (node {row['node_id']})"
+        stages = report["per_worker"][label]["stages"]
+        assert report["per_worker"][label]["push_count"] == \
+            row["push_count"]
+        for stage, metric_sum in (("push", row["push_us_sum"]),
+                                  ("pull", row["pull_us_sum"])):
+            assert abs(stages[stage] - metric_sum) <= 0.1 * metric_sum, (
+                stage, stages[stage], metric_sum)
+    # The report attributes server work too (wire_ack requires the
+    # (sender, req) join between worker and server dumps to land).
+    assert report["fleet_stages_us"].get("server_sum", 0) > 0
+    assert "wire_ack" in report["fleet_stages_us"]
+    assert report["fleet_stages_us"].get("queue", 0) >= 0
+
+
+# --- flight recorder on the recovery path --------------------------------
+
+RECOVERY_ENV = {
+    "PS_HEARTBEAT_INTERVAL": "0.5",
+    "PS_HEARTBEAT_TIMEOUT": "2",
+    "BYTEPS_RECOVERY_TIMEOUT_MS": "20000",
+    "BYTEPS_RETRY_TIMEOUT_MS": "300",
+    "BYTEPS_RECONNECT_BACKOFF_MS": "50",
+    "BYTEPS_LOG_LEVEL": "INFO",
+}
+
+
+def _server_node_id(proc, timeout_s=60.0):
+    deadline = time.time() + timeout_s
+    for line in proc.stdout:
+        m = re.search(r"node started: role=1 id=(\d+)", line)
+        if m:
+            return int(m.group(1))
+        if time.time() > deadline:
+            break
+    raise AssertionError("server never logged its assigned node id")
+
+
+def _wait_for_round(worker, rnd, timeout_s=120.0):
+    deadline = time.time() + timeout_s
+    for line in worker.stdout:
+        if line.startswith(f"round {rnd}"):
+            return
+        if time.time() > deadline:
+            break
+    raise AssertionError(f"worker never reached round {rnd}")
+
+
+@pytest.mark.recovery
+def test_flight_recorder_auto_dumps_on_recovery(tmp_path):
+    """Kill one of two servers mid-round (test_recovery.py pattern):
+    with NOTHING configured beyond defaults (flight recorder is
+    default-on), every rank auto-dumps its flight ring into the trace
+    dir, and the merged flight view shows EPOCH_PAUSE -> EPOCH_RESUME ->
+    the re-seed trail."""
+    port = free_port()
+    # Long inter-round sleep: the whole kill -> detect -> replace ->
+    # re-seed cycle (~4.5 s with these clocks) lands in the IDLE gap, so
+    # every partition on the dead rank is at the completed-round state
+    # and the recovery deterministically re-seeds retained aggregates
+    # (RESEED_OFFER) instead of racing round 2's in-flight pushes.
+    env = topology_env(2, 2, port,
+                       dict(RECOVERY_ENV,
+                            BYTEPS_TRACE_DIR=str(tmp_path),
+                            BPS_TEST_ROUNDS="4",
+                            BPS_TEST_ROUND_SLEEP="6"))
+    sched = spawn_role("scheduler", env)
+    servers = [spawn_role("server", env) for _ in range(2)]
+    workers = [spawn_worker(WORKER, env, r, "recovery")
+               for r in range(2)]
+    replacement = None
+    try:
+        victim = servers[0]
+        victim_id = _server_node_id(victim)
+        _wait_for_round(workers[0], 1)
+        victim.kill()
+        time.sleep(4.0)  # past the heartbeat timeout: detection path
+        renv = dict(env)
+        renv["DMLC_RECOVER_RANK"] = str(victim_id - 1)
+        replacement = spawn_role("server", renv)
+        rows = []
+        for wp in workers:
+            out, _ = wp.communicate(timeout=150)
+            assert wp.returncode == 0, out
+            rows += [json.loads(ln) for ln in out.splitlines()
+                     if ln.startswith("{")]
+        for p in (servers[1], replacement, sched):
+            out, _ = p.communicate(timeout=30)
+            assert p.returncode == 0, out
+        assert all(r["recoveries"] == 1 for r in rows), rows
+    finally:
+        procs = [sched, *servers, *workers]
+        if replacement is not None:
+            procs.append(replacement)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+    # Every surviving rank left a flight dump: scheduler (n0), the
+    # surviving server, both workers, and the replacement (which dumps
+    # at its clean exit because it ran a recovery incarnation).
+    files = {os.path.basename(str(p)): json.load(open(p))
+             for p in tmp_path.glob("flight_r*_n*.json")}
+    by_role = {}
+    for name, dump in files.items():
+        role = int(re.match(r"flight_r(\d)_n(\d+)\.json", name).group(1))
+        by_role.setdefault(role, []).append(dump)
+    assert len(by_role.get(0, [])) == 1, files.keys()   # scheduler
+    assert len(by_role.get(2, [])) == 2, files.keys()   # both workers
+    # Two server dumps: the survivor (pause/resume triggers) and the
+    # replacement (re-seed trail left at its clean exit).
+    assert len(by_role.get(1, [])) == 2, files.keys()
+
+    def names(dump):
+        return [e["name"] for e in dump["traceEvents"]]
+
+    # Scheduler: it coordinated the epoch — pause, the replacement's
+    # registration, and the resume are all in its ring.
+    sched_names = names(by_role[0][0])
+    for ev in ("EPOCH_PAUSE", "RECOVER_REGISTER", "EPOCH_RESUME"):
+        assert ev in sched_names, sched_names
+    # Workers: saw the pause and the resume, and offered re-seeds.
+    for w in by_role[2]:
+        wn = names(w)
+        assert "EPOCH_PAUSE" in wn, wn
+        assert "EPOCH_RESUME" in wn, wn
+        assert "RESEED_OFFER" in wn, wn
+        assert "RECOVER_DONE" in wn, wn
+    # The replacement's ring carries the server-side re-seed trail.
+    assert any("RESEED" in names(s) for s in by_role[1]), \
+        [names(s) for s in by_role[1]]
+
+    # Merged flight view: the sequence reads PAUSE -> RESUME -> re-seed
+    # in clock-aligned fleet order.
+    from byteps_tpu.monitor.timeline import gather, merge_dumps
+    merged = merge_dumps(gather(str(tmp_path), "flight_*.json"),
+                         out_path=str(tmp_path / "flight_fleet.json"))
+    ts = {}
+    for e in merged["traceEvents"]:
+        if "ts" in e and e["name"] in ("EPOCH_PAUSE", "EPOCH_RESUME",
+                                       "RESEED_OFFER"):
+            ts.setdefault(e["name"], []).append(e["ts"])
+    assert min(ts["EPOCH_PAUSE"]) < min(ts["EPOCH_RESUME"]), ts
+    assert min(ts["EPOCH_RESUME"]) < max(ts["RESEED_OFFER"]), ts
