@@ -71,24 +71,32 @@ def run_launcher(workers: int, servers: int, example_args, env_extra=None,
 
 
 def mode_converge(args):
+    # (name, compressor config, extra env). wire_quant_int8 (ISSUE 6) is
+    # not a per-key codec at all — it arms the block-quantized WIRE
+    # (BYTEPS_WIRE_QUANT int8 sub-payloads + worker-side EF residuals +
+    # server dequant-sum), so dense vs wire_quant_int8 is the "EF path
+    # tracks dense" A/B for the quantized fused wire.
     codecs = [
-        ("dense", ""),
-        ("onebit_ef", "type=onebit;ef=vanilla"),
-        ("topk_ef", f"type=topk;k={args.topk_k};ef=vanilla"),
-        ("dithering", "type=dithering;k=4"),
+        ("dense", "", {}),
+        ("onebit_ef", "type=onebit;ef=vanilla", {}),
+        ("topk_ef", f"type=topk;k={args.topk_k};ef=vanilla", {}),
+        ("dithering", "type=dithering;k=4", {}),
         # Round-5 additions (VERDICT r4 weak #7): randomk needs EF to
         # recover the unsampled mass, and the Nesterov momentum decorator
         # had only registry/unit coverage — both now get trajectories.
-        ("randomk_ef", f"type=randomk;k={args.topk_k};seed=7;ef=vanilla"),
+        ("randomk_ef", f"type=randomk;k={args.topk_k};seed=7;ef=vanilla",
+         {}),
         ("topk_nesterov",
-         f"type=topk;k={args.topk_k};momentum=nesterov;mu=0.9;ef=vanilla"),
+         f"type=topk;k={args.topk_k};momentum=nesterov;mu=0.9;ef=vanilla",
+         {}),
+        ("wire_quant_int8", "", {"BYTEPS_WIRE_QUANT": "1"}),
     ]
     if args.codecs:
         want = set(args.codecs.split(","))
-        unknown = want - {n for n, _ in codecs}
+        unknown = want - {n for n, _, _ in codecs}
         if unknown:
             raise SystemExit(f"unknown codecs {sorted(unknown)}")
-        codecs = [(n, c) for n, c in codecs if n in want]
+        codecs = [(n, c, e) for n, c, e in codecs if n in want]
     # ONE virtual device per worker: data parallelism comes from the two
     # worker PROCESSES through the PS fleet (the thing under test); a
     # forced multi-device platform inside each worker adds in-jit
@@ -107,14 +115,14 @@ def mode_converge(args):
                     "(~29M params)",
            "steps": args.steps, "batch": args.batch,
            "seq_len": args.seq_len, "runs": []}
-    for name, cfg in codecs:
+    for name, cfg, extra_env in codecs:
         ex_args = ["--model", "mid", "--steps", str(args.steps),
                    "--batch-size", str(args.batch),
                    "--seq-len", str(args.seq_len),
                    "--log-every", str(args.log_every)]
         if cfg:
             ex_args += ["--compressor", cfg]
-        row = run_launcher(2, 1, ex_args, env_extra=env)
+        row = run_launcher(2, 1, ex_args, env_extra={**env, **extra_env})
         row["codec"] = name
         out["runs"].append(row)
         print(json.dumps({k: v for k, v in row.items()

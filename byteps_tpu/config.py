@@ -67,6 +67,21 @@ class Config:
     fusion_linger_us: int = 200           # BYTEPS_FUSION_LINGER_US
     #   how long the collector waits for the next fusible task before
     #   flushing a partial batch (0 = flush immediately)
+
+    # --- block-quantized wire (ISSUE 6; docs/performance.md) ---------------
+    wire_quant: bool = False              # BYTEPS_WIRE_QUANT
+    #   encode codec-less float32 partitions as per-block (scale, int8)
+    #   on the wire — pushes worker-side with per-key error-feedback
+    #   residuals, pull replies re-quantized server-side; the server
+    #   dequantizes into its float32 accumulator, so summation order and
+    #   precision match the dense wire. 0 (the default) is byte-for-byte
+    #   today's wire
+    wire_quant_block: int = 64            # BYTEPS_WIRE_QUANT_BLOCK
+    #   quantization block: one f32 scale per this many elements; must
+    #   be a power of two in [16, 32768]
+    wire_quant_min_bytes: int = 1024      # BYTEPS_WIRE_QUANT_MIN_BYTES
+    #   partitions under this many raw bytes ship raw float32 (the
+    #   per-block scale overhead isn't worth it on tiny tensors)
     local_rank: int = 0                   # BYTEPS_LOCAL_RANK
     local_size: int = 1                   # BYTEPS_LOCAL_SIZE
     log_level: str = "WARNING"            # BYTEPS_LOG_LEVEL
@@ -231,6 +246,45 @@ class Config:
             raise ValueError(
                 "BYTEPS_FUSION_LINGER_US must be >= 0 (microseconds the "
                 "fusion collector waits before flushing a partial batch)")
+        if (self.wire_quant_block < 16 or self.wire_quant_block > 32768
+                or self.wire_quant_block & (self.wire_quant_block - 1)):
+            raise ValueError(
+                f"BYTEPS_WIRE_QUANT_BLOCK ({self.wire_quant_block}) must "
+                "be a power of two in [16, 32768]: one f32 scale is "
+                "shipped per block, and the decode path rejects any "
+                "other geometry as a malformed frame")
+        if self.wire_quant_min_bytes < 0:
+            raise ValueError(
+                "BYTEPS_WIRE_QUANT_MIN_BYTES must be >= 0 (partitions "
+                "under it ship raw float32)")
+        if self.wire_quant and self.compressor:
+            # The quantized wire operates on RAW float32 sub-payloads;
+            # a fleet-wide codec means every key ships compressor bytes
+            # instead, so quant would silently never engage — reject the
+            # contradiction instead of shipping a no-op config. Per-key
+            # overrides still compose: declare_tensor(compression=...)
+            # keys ship codec bytes, codec-less float32 keys quantize.
+            raise ValueError(
+                "BYTEPS_WIRE_QUANT requires the fused wire's raw float32 "
+                "payloads, but BYTEPS_COMPRESSOR "
+                f"({self.compressor!r}) puts a codec on every key — "
+                "quant would never apply. Drop one, or move the codec "
+                "to per-tensor declare_tensor(compression=...) overrides")
+        if self.wire_quant and self.enable_async:
+            # Async keeps the authoritative accumulator server-side and
+            # applies each push as it lands: the accumulator integrates
+            # LOSSY deltas with no round boundary for error feedback to
+            # true them up against, so the async parameter drifts by the
+            # accumulated quantization error. Legal, but worth a loud
+            # nudge.
+            import warnings
+            warnings.warn(
+                "BYTEPS_WIRE_QUANT with BYTEPS_ENABLE_ASYNC: the async "
+                "server accumulator integrates lossy int8 deltas "
+                "directly (worker-side error feedback compensates "
+                "ACROSS rounds, not within the server's running sum); "
+                "expect parameter drift proportional to the per-push "
+                "quantization error", stacklevel=2)
         if self.trace_start_step < 1:
             raise ValueError(
                 "BYTEPS_TRACE_START_STEP must be >= 1 (steps are "
@@ -391,6 +445,9 @@ def load_config() -> Config:
         fusion_bytes=_env_int("BYTEPS_FUSION_BYTES", 65536),
         fusion_keys=_env_int("BYTEPS_FUSION_KEYS", 128),
         fusion_linger_us=_env_int("BYTEPS_FUSION_LINGER_US", 200),
+        wire_quant=_env_bool("BYTEPS_WIRE_QUANT"),
+        wire_quant_block=_env_int("BYTEPS_WIRE_QUANT_BLOCK", 64),
+        wire_quant_min_bytes=_env_int("BYTEPS_WIRE_QUANT_MIN_BYTES", 1024),
         local_rank=_env_int("BYTEPS_LOCAL_RANK", 0),
         local_size=_env_int("BYTEPS_LOCAL_SIZE", 1),
         log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING").upper(),

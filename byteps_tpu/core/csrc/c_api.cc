@@ -5,13 +5,16 @@
 // byteps_rank / ...; SURVEY.md §2.1) — env-var configured exactly like the
 // reference (DMLC_* / BYTEPS_* families, docs/ENV.md).
 #include <chrono>
+#include <cmath>
 #include <cstdio>
 #include <cstring>
+#include <limits>
 #include <memory>
 #include <string>
 #include <vector>
 
 #include "common.h"
+#include "compressor.h"
 #include "cpu_reducer.h"
 #include "debug.h"
 #include "kv.h"
@@ -290,6 +293,59 @@ void bps_trace_step(int step) { Trace::Get().SetStep(step); }
 // the flight recorder (also the test hook for ring wraparound).
 void bps_trace_note(const char* name, long long key) {
   if (name) Trace::Get().Note(name, key);
+}
+
+// Compressor roundtrip probe (no topology needed): encode `n` float32
+// elements of `src` with the codec built from `config`, decode into
+// `dst`, and return the encoded byte count. Errors are returned, not
+// CHECK-crashed, so tests can assert on them: -1 = bad/empty config,
+// -2 = non-finite input (the in-core push path CHECK-crashes on the
+// same condition — "error loudly rather than encode garbage").
+long long bps_compressor_roundtrip(const char* config, const void* src,
+                                   long long n, void* dst) {
+  if (!config || !src || !dst || n <= 0) return -1;
+  const float* s = static_cast<const float*>(src);
+  for (long long i = 0; i < n; ++i) {
+    if (!(std::fabs(s[i]) <= std::numeric_limits<float>::max())) {
+      return -2;
+    }
+  }
+  // Pre-validate the type: CreateCompressor treats an unknown type as a
+  // fatal misconfiguration (BPS_FATAL), which a probe must not be.
+  auto kv = ParseCompressorConfig(config);
+  auto type_it = kv.find("type");
+  if (type_it == kv.end() ||
+      (type_it->second != "onebit" && type_it->second != "topk" &&
+       type_it->second != "randomk" && type_it->second != "dithering")) {
+    return -1;
+  }
+  std::unique_ptr<Compressor> c = CreateCompressor(config, n);
+  if (!c) return -1;
+  std::vector<char> enc;
+  c->Compress(s, n, &enc);
+  c->Decompress(enc.data(), static_cast<int64_t>(enc.size()),
+                static_cast<float*>(dst), n);
+  return static_cast<long long>(enc.size());
+}
+
+// BlockQuant (ISSUE 6 wire codec) roundtrip probe: encode `src` with
+// the given block, decode into `dst`, return encoded bytes. -1 = an
+// invalid block (not a power of two in [16, 32768]) or bad args,
+// -2 = non-finite input refused by the encoder.
+long long bps_quant_roundtrip(const void* src, long long n, int block,
+                              void* dst) {
+  if (!src || !dst || n <= 0) return -1;
+  if (!BlockQuant::ValidBlock(block)) return -1;
+  std::vector<char> enc;
+  if (!BlockQuant::Encode(static_cast<const float*>(src), n, block,
+                          &enc)) {
+    return -2;
+  }
+  if (!BlockQuant::Decode(enc.data(), static_cast<int64_t>(enc.size()),
+                          static_cast<float*>(dst), n)) {
+    return -1;
+  }
+  return static_cast<long long>(enc.size());
 }
 
 // Standalone CpuReducer throughput probe: repeatedly sum a src buffer

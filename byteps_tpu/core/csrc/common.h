@@ -146,6 +146,15 @@ inline bool IsDataPlaneCmd(int32_t cmd) {
 enum MsgFlags : int32_t {
   FLAG_COMPRESSED = 1 << 0,  // payload is compressor output
   FLAG_ASYNC = 1 << 1,       // async-mode operation
+  FLAG_WIRE_QUANT = 1 << 2,  // payload is the block-quantized int8 wire
+                             // encoding (BlockQuant, compressor.h): on a
+                             // PUSH the sender encoded the raw float32
+                             // partition; on a PULL it REQUESTS the
+                             // quantized aggregate; on a PULL_RESP the
+                             // server re-quantized the reply (arg0 =
+                             // decoded byte length). Mutually exclusive
+                             // with FLAG_COMPRESSED — quantization only
+                             // applies to codec-less float32 keys.
 };
 
 // --- wire header ------------------------------------------------------------
@@ -188,7 +197,16 @@ struct MsgHeader {
 #pragma pack(push, 1)
 struct SubHeader {
   int64_t key = 0;
-  int32_t cmd = 0;
+  int16_t cmd = 0;        // sub-operation command (values are tiny)
+  // Wire encoding of this entry's sub-payload (ISSUE 6, quantized fused
+  // wire): BPS_FLOAT32 (0, the default — the payload is the raw `dtype`
+  // bytes, exactly the pre-quant wire) or BPS_INT8 (the BlockQuant
+  // int8 encoding; FLAG_WIRE_QUANT is set in `flags` alongside it).
+  // Carved out of the old int32 `cmd` (whose values never exceeded 25),
+  // so a quant-off frame is byte-for-byte identical to the pre-quant
+  // table layout: cmd's little-endian bytes [lo, 0] followed by
+  // wire_dtype [0, 0] reproduce the old 4-byte cmd exactly.
+  int16_t wire_dtype = 0;
   int32_t version = 0;
   int32_t dtype = 0;
   int32_t flags = 0;

@@ -1,6 +1,7 @@
 #include "compressor.h"
 
 #include <algorithm>
+#include <cfloat>
 #include <cmath>
 #include <cstring>
 #include <random>
@@ -8,6 +9,32 @@
 #include "logging.h"
 
 namespace bps {
+
+namespace {
+
+// True iff every value is finite. `!(|v| <= FLT_MAX)` is NaN-proof:
+// a NaN fails every comparison, while std::isfinite can be elided
+// under -ffast-math and NaN never survives std::max.
+bool AllFinite(const float* src, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (!(std::fabs(src[i]) <= FLT_MAX)) return false;
+  }
+  return true;
+}
+
+// A NaN/Inf gradient poisons every lossy encoding differently (onebit's
+// mean scale goes NaN, sparse-k sorts it to the top, dithering divides
+// by it) — all of them would silently encode garbage the server then
+// sums into every worker's aggregate. Crash at the boundary with the
+// key diagnosis instead; a non-finite gradient is a training bug, not
+// a wire condition.
+void CheckFiniteInput(const float* src, int64_t n, const char* who) {
+  BPS_CHECK(AllFinite(src, n))
+      << who << ": non-finite value in compressor input (" << n
+      << " elements) — refusing to encode garbage";
+}
+
+}  // namespace
 
 std::unordered_map<std::string, std::string> ParseCompressorConfig(
     const std::string& config) {
@@ -35,6 +62,7 @@ namespace {
 class OnebitCompressor : public Compressor {
  public:
   void Compress(const float* src, int64_t n, std::vector<char>* out) override {
+    CheckFiniteInput(src, n, "onebit");
     int64_t nbytes = (n + 7) / 8;
     out->assign(sizeof(float) + nbytes, 0);
     double sum_abs = 0;
@@ -69,6 +97,7 @@ class SparseKCompressor : public Compressor {
       : k_(k), random_(random), rng_(seed) {}
 
   void Compress(const float* src, int64_t n, std::vector<char>* out) override {
+    CheckFiniteInput(src, n, random_ ? "randomk" : "topk");
     int64_t k = std::min<int64_t>(k_, n);
     std::vector<int64_t> idx;
     if (random_) {
@@ -140,6 +169,7 @@ class DitheringCompressor : public Compressor {
   explicit DitheringCompressor(uint64_t seed) : rng_(seed) {}
 
   void Compress(const float* src, int64_t n, std::vector<char>* out) override {
+    CheckFiniteInput(src, n, "dithering");
     float maxabs = 0;
     for (int64_t i = 0; i < n; ++i)
       maxabs = std::max(maxabs, std::fabs(src[i]));
@@ -275,6 +305,101 @@ std::unique_ptr<Compressor> CreateCompressor(const std::string& config,
     c = std::make_unique<ErrorFeedback>(std::move(c), n);
   }
   return c;
+}
+
+// --- BlockQuant wire codec (ISSUE 6) ----------------------------------------
+
+namespace {
+
+constexpr uint16_t kBlockQuantMagic = 0xB10C;
+
+#pragma pack(push, 1)
+struct BlockQuantHeader {
+  uint16_t magic;
+  uint16_t block;
+  int32_t nelem;
+};
+#pragma pack(pop)
+
+// Shared encode body: when `residual` is non-null it IS the source and
+// receives the EF update (residual -= decode(encoded)) in the same pass.
+bool BlockQuantEncodeImpl(const float* src, float* residual, int64_t n,
+                          int block, std::vector<char>* out) {
+  if (!BlockQuant::ValidBlock(block) || n < 0) return false;
+  const int64_t nblocks = (n + block - 1) / block;
+  out->resize(static_cast<size_t>(BlockQuant::EncodedSize(n, block)));
+  auto* hdr = reinterpret_cast<BlockQuantHeader*>(out->data());
+  hdr->magic = kBlockQuantMagic;
+  hdr->block = static_cast<uint16_t>(block);
+  hdr->nelem = static_cast<int32_t>(n);
+  float* scales =
+      reinterpret_cast<float*>(out->data() + sizeof(BlockQuantHeader));
+  int8_t* q = reinterpret_cast<int8_t*>(
+      out->data() + sizeof(BlockQuantHeader) + nblocks * sizeof(float));
+  for (int64_t b = 0; b < nblocks; ++b) {
+    const int64_t lo = b * block;
+    const int64_t hi = std::min<int64_t>(lo + block, n);
+    float absmax = 0.0f;
+    for (int64_t i = lo; i < hi; ++i) {
+      const float a = std::fabs(src[i]);
+      // NaN-proof finiteness gate (a NaN fails every comparison, so it
+      // can neither become absmax nor pass this check).
+      if (!(a <= FLT_MAX)) return false;
+      if (a > absmax) absmax = a;
+    }
+    // All-zero block: scale 0 encodes — and decodes — exact zeros.
+    const float scale = absmax / 127.0f;
+    scales[b] = scale;
+    const float inv = scale > 0.0f ? 1.0f / scale : 0.0f;
+    for (int64_t i = lo; i < hi; ++i) {
+      int v = static_cast<int>(std::lrintf(src[i] * inv));
+      if (v > 127) v = 127;
+      if (v < -127) v = -127;
+      q[i] = static_cast<int8_t>(v);
+      if (residual) residual[i] -= static_cast<float>(v) * scale;
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+bool BlockQuant::Encode(const float* src, int64_t n, int block,
+                        std::vector<char>* out) {
+  return BlockQuantEncodeImpl(src, nullptr, n, block, out);
+}
+
+bool BlockQuant::EncodeEF(float* residual, int64_t n, int block,
+                          std::vector<char>* out) {
+  return BlockQuantEncodeImpl(residual, residual, n, block, out);
+}
+
+bool BlockQuant::Decode(const char* src, int64_t src_bytes, float* dst,
+                        int64_t n) {
+  if (src_bytes < static_cast<int64_t>(sizeof(BlockQuantHeader))) {
+    return false;
+  }
+  BlockQuantHeader hdr;
+  memcpy(&hdr, src, sizeof(hdr));
+  const int block = hdr.block;
+  if (hdr.magic != kBlockQuantMagic || !ValidBlock(block) ||
+      hdr.nelem != n || src_bytes != EncodedSize(n, block)) {
+    return false;
+  }
+  const int64_t nblocks = (n + block - 1) / block;
+  const float* scales =
+      reinterpret_cast<const float*>(src + sizeof(BlockQuantHeader));
+  const int8_t* q = reinterpret_cast<const int8_t*>(
+      src + sizeof(BlockQuantHeader) + nblocks * sizeof(float));
+  for (int64_t b = 0; b < nblocks; ++b) {
+    const int64_t lo = b * block;
+    const int64_t hi = std::min<int64_t>(lo + block, n);
+    const float scale = scales[b];
+    for (int64_t i = lo; i < hi; ++i) {
+      dst[i] = static_cast<float>(q[i]) * scale;
+    }
+  }
+  return true;
 }
 
 }  // namespace bps

@@ -41,4 +41,44 @@ std::unique_ptr<Compressor> CreateCompressor(const std::string& config,
 std::unordered_map<std::string, std::string> ParseCompressorConfig(
     const std::string& config);
 
+// --- block-quantized wire codec (ISSUE 6) -----------------------------------
+// EQuARX-style per-block int8 encoding for the fused data plane
+// (BYTEPS_WIRE_QUANT): each block of `block` float32 values ships as one
+// f32 absmax-derived scale plus `block` int8 codes. Unlike the Compressor
+// plugins above — per-key stateful objects selected per tensor — this is
+// a stateless, self-describing WIRE format: any rank can decode any
+// frame from the payload alone, resends ship snapshot bytes untouched,
+// and the server dequant-sums into its float32 accumulator.
+//
+// Wire layout: [u16 magic 0xB10C][u16 block][i32 nelem]
+//              [ceil(n/block) f32 scales][n int8 codes]
+// ~3.8x smaller than raw float32 at block=64. Error feedback is the
+// CALLER's job (the worker keeps per-key residuals; EncodeEF folds the
+// residual update into the encode pass).
+struct BlockQuant {
+  // Blocks must be a power of two in [16, 32768] (config.py validates
+  // the env knob; this is the wire-level contract Decode enforces too).
+  static bool ValidBlock(int block) {
+    return block >= 16 && block <= 32768 && (block & (block - 1)) == 0;
+  }
+  static int64_t EncodedSize(int64_t n, int block) {
+    int64_t nblocks = (n + block - 1) / block;
+    return 8 + nblocks * static_cast<int64_t>(sizeof(float)) + n;
+  }
+  // Encode n floats. Returns false — without producing output — on a
+  // NaN/Inf input or an invalid block: a non-finite gradient must error
+  // loudly at the encode boundary, never ship as garbage codes.
+  static bool Encode(const float* src, int64_t n, int block,
+                     std::vector<char>* out);
+  // Error-feedback variant: `residual` already holds gradient + carried
+  // residual; encodes it and subtracts the decoded value in place, so
+  // the quantization error of THIS round rides into the next one.
+  static bool EncodeEF(float* residual, int64_t n, int block,
+                       std::vector<char>* out);
+  // Decode into dst (n floats). Returns false on a malformed payload
+  // (bad magic/block/element count/length) instead of reading garbage.
+  static bool Decode(const char* src, int64_t src_bytes, float* dst,
+                     int64_t n);
+};
+
 }  // namespace bps

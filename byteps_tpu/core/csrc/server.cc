@@ -15,6 +15,19 @@ namespace bps {
 void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
   po_ = po;
   async_ = async_mode;
+  // Quantized wire (ISSUE 6): same env the worker reads, same backstop
+  // clamp, so both ends compute identical per-key eligibility.
+  if (const char* qv = getenv("BYTEPS_WIRE_QUANT")) {
+    wire_quant_ = atoi(qv) != 0;
+  }
+  if (const char* qb = getenv("BYTEPS_WIRE_QUANT_BLOCK")) {
+    quant_block_ = atoi(qb);
+  }
+  if (!BlockQuant::ValidBlock(quant_block_)) quant_block_ = 64;
+  if (const char* qm = getenv("BYTEPS_WIRE_QUANT_MIN_BYTES")) {
+    quant_min_bytes_ = atoll(qm);
+    if (quant_min_bytes_ < 0) quant_min_bytes_ = 0;
+  }
   const char* rr = getenv("DMLC_RECOVER_RANK");
   recover_mode_.store(rr && *rr);
   if (recover_mode_.load()) {
@@ -38,6 +51,11 @@ void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
   Metrics::Get().Counter("bps_server_reply_bytes_total");
   Metrics::Get().Counter("bps_server_sum_bytes_total");
   Metrics::Get().Counter("bps_fused_msgs_total");
+  // Quantized-wire accounting, reply leg (the push leg's encoded bytes
+  // already land in bps_recv_bytes_total — the parity contract counts
+  // what actually crossed the wire on BOTH sides).
+  Metrics::Get().Counter("bps_quant_bytes_on_wire_total");
+  Metrics::Get().Counter("bps_quant_bytes_saved_total");
   Metrics::Get().Histogram("bps_server_sum_us");
   Metrics::Get().Histogram("bps_fusion_batch_keys");
   queues_.clear();
@@ -126,6 +144,12 @@ void BytePSServer::HandleMulti(Message&& msg, int fd) {
         << "multi sub-payload out of range: key " << s.key;
     BPS_CHECK_EQ(s.cmd, is_push ? CMD_PUSH : CMD_PULL)
         << "unexpected sub-cmd in multi frame";
+    // Wire-dtype/flag consistency: the table field and the flag bit are
+    // one contract (BPS_INT8 <-> FLAG_WIRE_QUANT); a frame where they
+    // disagree was corrupted or built by a broken sender.
+    BPS_CHECK((s.wire_dtype == BPS_INT8) ==
+              ((s.flags & FLAG_WIRE_QUANT) != 0))
+        << "sub-entry wire_dtype/quant-flag mismatch for key " << s.key;
     EngineTask t;
     t.msg.head.cmd = s.cmd;
     t.msg.head.sender = h.sender;
@@ -165,7 +189,10 @@ void BytePSServer::SendReply(const EngineTask& t, MsgHeader& head,
   MultiReply& b = *t.batch;
   SubHeader& s = b.subs[t.sub_idx];
   s.key = head.key;
-  s.cmd = head.cmd;
+  s.cmd = static_cast<int16_t>(head.cmd);
+  s.wire_dtype = (head.flags & FLAG_WIRE_QUANT)
+                     ? static_cast<int16_t>(BPS_INT8)
+                     : static_cast<int16_t>(0);
   s.version = head.version;
   s.dtype = head.dtype;
   s.flags = head.flags;
@@ -311,6 +338,18 @@ void BytePSServer::AnswerDuplicate(KeyStore* ks, KeyStore::SenderRec& rec,
         if (head.flags & FLAG_COMPRESSED) {
           SendReply(task, head, ks->comp_reply[slot].data(),
                     static_cast<int64_t>(ks->comp_reply[slot].size()));
+        } else if ((head.flags & FLAG_WIRE_QUANT) &&
+                   !ks->qreply[slot].empty()) {
+          // Replay the round's cached quantized encode — the same
+          // bytes the original reply carried.
+          SendReply(task, head, ks->qreply[slot].data(),
+                    static_cast<int64_t>(ks->qreply[slot].size()));
+        } else if (head.flags & FLAG_WIRE_QUANT) {
+          // Cache gone (a re-seed cleared it): re-serve the retained
+          // raw aggregate instead, honestly declared as raw.
+          head.flags &= ~FLAG_WIRE_QUANT;
+          SendReply(task, head, ks->slot[slot].data(),
+                    static_cast<int64_t>(ks->slot[slot].size()));
         } else {
           SendReply(task, head, ks->slot[slot].data(),
                     static_cast<int64_t>(ks->slot[slot].size()));
@@ -389,6 +428,17 @@ void BytePSServer::Process(EngineTask&& task) {
           ks->len = h.arg0;
           ks->dtype = h.dtype;
           ks->comp_config.assign(msg.payload.begin(), msg.payload.end());
+          // Quantized-wire eligibility: the same predicate the worker
+          // evaluates (QuantEligible + codec-less), so the two ends
+          // agree without negotiation. scratch doubles as the dequant
+          // target (codec keys and quant keys are disjoint).
+          ks->quant_ok = wire_quant_ && ks->comp_config.empty() &&
+                         ks->dtype == BPS_FLOAT32 &&
+                         ks->len >= quant_min_bytes_;
+          if (ks->quant_ok) {
+            ks->scratch.resize(ks->len /
+                               static_cast<int64_t>(sizeof(float)));
+          }
           if (!ks->comp_config.empty()) {
             int64_t n = ks->len / static_cast<int64_t>(sizeof(float));
             ks->compressor = CreateCompressor(ks->comp_config, n);
@@ -506,6 +556,27 @@ void BytePSServer::Process(EngineTask&& task) {
         ks->compressor->Decompress(data, data_len, ks->scratch.data(), n);
         data = reinterpret_cast<const char*>(ks->scratch.data());
         data_len = ks->len;
+      } else if (h.flags & FLAG_WIRE_QUANT) {
+        // Dequant-sum (ISSUE 6): decode the block-quantized push into
+        // scratch; the accumulator below stays float32, so summation
+        // order and precision are EXACTLY the dense path's — only the
+        // per-worker payload is lossy (compensated by the worker's EF).
+        BPS_CHECK(ks->quant_ok)
+            << "quantized push for non-eligible key " << h.key
+            << " (codec/dtype/min-bytes mismatch between worker and "
+               "server config)";
+        int64_t n = ks->len / static_cast<int64_t>(sizeof(float));
+        BPS_CHECK(BlockQuant::Decode(data, data_len, ks->scratch.data(),
+                                     n))
+            << "malformed quantized push for key " << h.key;
+        BPS_METRIC_COUNTER_ADD(
+            "bps_quant_bytes_on_wire_total",
+            static_cast<int64_t>(msg.payload.size()));
+        BPS_METRIC_COUNTER_ADD(
+            "bps_quant_bytes_saved_total",
+            ks->len - static_cast<int64_t>(msg.payload.size()));
+        data = reinterpret_cast<const char*>(ks->scratch.data());
+        data_len = ks->len;
       }
       BPS_CHECK_EQ(data_len, ks->len) << "push length mismatch for " << h.key;
 
@@ -547,6 +618,11 @@ void BytePSServer::Process(EngineTask&& task) {
                 reinterpret_cast<const float*>(ks->slot[slot].data()),
                 ks->len / static_cast<int64_t>(sizeof(float)),
                 &ks->comp_reply[slot]);
+          } else if (ks->quant_ok) {
+            // Re-quantize the aggregate once per round; every flagged
+            // pull (and every dedup replay) serves the same cached
+            // bytes, so replies stay deterministic under chaos.
+            EncodeQuantReply(ks, slot);
           }
           // Release pulls that arrived before the last push — but only
           // this round's; a later round's pulls stay parked. Move the
@@ -662,6 +738,10 @@ void BytePSServer::Process(EngineTask&& task) {
           ks->ready[slot] = false;
         }
         ks->comp_reply[slot].clear();
+        // The quantized-reply cache is stale too: a re-seeded slot
+        // serves the authoritative float32 bytes raw (the reseed IS
+        // what the fault-free workers decoded — see ServeRetainedPull).
+        ks->qreply[slot].clear();
         // Pulls for this round parked before the reseed landed are
         // servable now.
         std::vector<EngineTask> waiting;
@@ -813,6 +893,26 @@ void BytePSServer::ServeRetainedPull(KeyStore* ks, int slot,
     MarkReplied(ks, req.sender, req.req_id, resp);
     SendReply(t, resp, ks->comp_reply[slot].data(),
               ks->comp_reply[slot].size());
+  } else if ((req.flags & FLAG_WIRE_QUANT) &&
+             !ks->qreply[slot].empty()) {
+    // Quantized replay window (same rule as comp_reply above); a
+    // re-seeded slot cleared the cache and serves the authoritative
+    // float32 below — which is byte-identical to what the fault-free
+    // run's workers DECODED, so recovery stays bit-identical.
+    resp.flags = FLAG_WIRE_QUANT;
+    resp.arg0 = ks->len;
+    BPS_METRIC_COUNTER_ADD(
+        "bps_server_reply_bytes_total",
+        static_cast<int64_t>(ks->qreply[slot].size()));
+    BPS_METRIC_COUNTER_ADD(
+        "bps_quant_bytes_on_wire_total",
+        static_cast<int64_t>(ks->qreply[slot].size()));
+    BPS_METRIC_COUNTER_ADD(
+        "bps_quant_bytes_saved_total",
+        ks->len - static_cast<int64_t>(ks->qreply[slot].size()));
+    MarkReplied(ks, req.sender, req.req_id, resp);
+    SendReply(t, resp, ks->qreply[slot].data(),
+              ks->qreply[slot].size());
   } else {
     BPS_METRIC_COUNTER_ADD("bps_server_reply_bytes_total",
                            static_cast<int64_t>(ks->slot[slot].size()));
@@ -846,6 +946,26 @@ bool BytePSServer::ReplyPull(KeyStore* ks, int slot, const EngineTask& t) {
     MarkReplied(ks, req.sender, req.req_id, resp);
     SendReply(t, resp, ks->comp_reply[slot].data(),
               ks->comp_reply[slot].size());
+  } else if ((req.flags & FLAG_WIRE_QUANT) &&
+             !ks->qreply[slot].empty()) {
+    // Quantized reply leg: the round's cached re-quantized aggregate.
+    // Serve-by-request — a pull without the flag (or a slot whose
+    // cache a re-seed cleared) falls through to the raw bytes below,
+    // and the response header declares which encoding it carries.
+    resp.flags = FLAG_WIRE_QUANT;
+    resp.arg0 = ks->len;  // decoded size, for the worker's check
+    BPS_METRIC_COUNTER_ADD(
+        "bps_server_reply_bytes_total",
+        static_cast<int64_t>(ks->qreply[slot].size()));
+    BPS_METRIC_COUNTER_ADD(
+        "bps_quant_bytes_on_wire_total",
+        static_cast<int64_t>(ks->qreply[slot].size()));
+    BPS_METRIC_COUNTER_ADD(
+        "bps_quant_bytes_saved_total",
+        ks->len - static_cast<int64_t>(ks->qreply[slot].size()));
+    MarkReplied(ks, req.sender, req.req_id, resp);
+    SendReply(t, resp, ks->qreply[slot].data(),
+              ks->qreply[slot].size());
   } else {
     BPS_METRIC_COUNTER_ADD("bps_server_reply_bytes_total",
                            static_cast<int64_t>(ks->slot[slot].size()));
@@ -916,6 +1036,19 @@ void BytePSServer::ServeBcastRound(KeyStore* ks, int round, int fd,
   if (++it->second.served >= po_->num_workers() - 1) {
     ks->bcast_rounds.erase(it);
   }
+}
+
+void BytePSServer::EncodeQuantReply(KeyStore* ks, int slot) {
+  // NO error feedback on this leg (see KeyStore::quant_ok): the encode
+  // is a pure function of the aggregate, so a hot replacement's replies
+  // match the dead predecessor's bit for bit.
+  const int64_t n = ks->len / static_cast<int64_t>(sizeof(float));
+  BPS_CHECK(BlockQuant::Encode(
+      reinterpret_cast<const float*>(ks->slot[slot].data()), n,
+      quant_block_, &ks->qreply[slot]))
+      << "non-finite aggregate for key while re-quantizing pull reply "
+         "(slot " << slot << ") — a worker shipped garbage that the "
+         "dequant-sum accepted";
 }
 
 void BytePSServer::Stop() {

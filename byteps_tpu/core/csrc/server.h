@@ -133,6 +133,22 @@ class BytePSServer {
     // residual into the next round (DoubleSqueeze-style two-way EF).
     std::unique_ptr<Compressor> reply_comp;
     std::vector<char> comp_reply[2];  // cached encode, one per live round
+    // Quantized wire (ISSUE 6): true when this key's pushes may arrive
+    // block-quantized and its pull replies are re-quantized — quant
+    // armed fleet-wide, codec-less, float32, at least the minimum raw
+    // size (the worker computes the same predicate, so the two sides
+    // agree without negotiation). qreply mirrors comp_reply: the
+    // aggregate is encoded ONCE per round at round-ready and every
+    // flagged pull (and replay) serves the same cached bytes.
+    // Deliberately NO server-side EF residual on this leg: a hot
+    // replacement starts residual-less, so any server-resident carry
+    // would make post-recovery replies diverge from the fault-free
+    // run — breaking the recovery bit-identity contract. The reply
+    // rounding error is ~|aggregate|/254 per element, round-to-nearest
+    // (near-unbiased); the convergence A/B (BENCH_compression_r06)
+    // shows the worker-side push EF alone tracks dense (docs/rationale).
+    bool quant_ok = false;
+    std::vector<char> qreply[2];  // cached quantized encode per slot
     // sync mode: double-buffered rounds. round[s] is the full round
     // number (head.version) the slot currently accumulates/serves;
     // pushes/pulls for a LATER round that maps to a busy slot are parked
@@ -211,8 +227,17 @@ class BytePSServer {
   void ServeBcastRound(KeyStore* ks, int round, int fd,
                        const MsgHeader& req);
 
+  // Encode one round's aggregate into qreply[slot] (quant-eligible keys
+  // only; called at round-ready, exactly like the comp_reply encode).
+  void EncodeQuantReply(KeyStore* ks, int slot);
+
   Postoffice* po_ = nullptr;
   bool async_ = false;
+  // Quantized wire knobs (ISSUE 6), read from the same env the worker
+  // reads so both sides compute identical eligibility.
+  bool wire_quant_ = false;          // BYTEPS_WIRE_QUANT
+  int quant_block_ = 64;             // BYTEPS_WIRE_QUANT_BLOCK
+  int64_t quant_min_bytes_ = 1024;   // BYTEPS_WIRE_QUANT_MIN_BYTES
   // Replacement incarnation (DMLC_RECOVER_RANK set): data-plane ops may
   // legally arrive before their keys are re-declared — park them
   // instead of treating an unknown key as a protocol violation. The

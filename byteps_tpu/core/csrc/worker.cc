@@ -39,6 +39,27 @@ void BytePSWorker::Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
     fusion_linger_us_ = atoll(lv);
     if (fusion_linger_us_ < 0) fusion_linger_us_ = 0;
   }
+  // Block-quantized wire (ISSUE 6): the Python config layer validates
+  // these; the clamp here is a backstop for direct FFI users so a bad
+  // block can never reach the codec (Encode refuses invalid blocks).
+  if (const char* qv = getenv("BYTEPS_WIRE_QUANT")) {
+    wire_quant_ = atoi(qv) != 0;
+  }
+  if (const char* qb = getenv("BYTEPS_WIRE_QUANT_BLOCK")) {
+    quant_block_ = atoi(qb);
+  }
+  if (!BlockQuant::ValidBlock(quant_block_)) {
+    if (wire_quant_) {
+      BPS_LOG(WARNING) << "BYTEPS_WIRE_QUANT_BLOCK=" << quant_block_
+                       << " is not a power of two in [16, 32768]; "
+                          "using 64";
+    }
+    quant_block_ = 64;
+  }
+  if (const char* qm = getenv("BYTEPS_WIRE_QUANT_MIN_BYTES")) {
+    quant_min_bytes_ = atoll(qm);
+    if (quant_min_bytes_ < 0) quant_min_bytes_ = 0;
+  }
   default_comp_ = std::move(default_comp);
   trace_on_ = trace_on;
   // Pre-register the worker-side metric catalog: every stage's series
@@ -51,6 +72,12 @@ void BytePSWorker::Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
   Metrics::Get().Counter("bps_pull_bytes_total");
   Metrics::Get().Counter("bps_fused_msgs_total");
   Metrics::Get().Histogram("bps_fusion_batch_keys");
+  // Quantized-wire accounting (docs/monitoring.md): encoded bytes that
+  // actually crossed the wire and the raw-minus-encoded savings, both
+  // legs (push encode here, pull decode below). Present-from-zero so
+  // monitor.top's compression-ratio column reads 1.0x, not a hole.
+  Metrics::Get().Counter("bps_quant_bytes_on_wire_total");
+  Metrics::Get().Counter("bps_quant_bytes_saved_total");
   Metrics::Get().Histogram("bps_push_us");
   Metrics::Get().Histogram("bps_pull_us");
   // Transient-fault telemetry: present-from-zero so monitor.top and
@@ -533,6 +560,30 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
         BPS_METRIC_COUNTER_ADD("bps_compress_in_bytes_total", raw_len);
         BPS_METRIC_COUNTER_ADD("bps_compress_out_bytes_total",
                                op.payload_len);
+      } else if (QuantEligible(ctx, raw_len)) {
+        // Block-quantized wire (ISSUE 6): fold the gradient into the
+        // per-key EF residual, encode the residual as per-block int8,
+        // and carry the rounding error into the next round. The encoded
+        // qbuf is the wire payload — fused frames gather it, resend
+        // snapshots copy it, and a recovery RE-PUSH ships the identical
+        // bytes, which is what keeps the residual stream (and therefore
+        // every later round) bit-identical across fault and fault-free
+        // runs.
+        if (p->qresidual.empty()) p->qresidual.assign(p->len, 0.0f);
+        const float* g = reinterpret_cast<const float*>(base);
+        for (int64_t i = 0; i < p->len; ++i) p->qresidual[i] += g[i];
+        BPS_CHECK(BlockQuant::EncodeEF(p->qresidual.data(), p->len,
+                                       quant_block_, &p->qbuf))
+            << "non-finite gradient for key " << p->key
+            << " — refusing to quantize garbage onto the wire";
+        op.payload = p->qbuf.data();
+        op.payload_len = static_cast<int64_t>(p->qbuf.size());
+        op.flags |= FLAG_WIRE_QUANT;
+        Record(p->key, "compress", t0);
+        BPS_METRIC_COUNTER_ADD("bps_quant_bytes_on_wire_total",
+                               op.payload_len);
+        BPS_METRIC_COUNTER_ADD("bps_quant_bytes_saved_total",
+                               raw_len - op.payload_len);
       }
       if (fusion_sink_ != nullptr) {
         // PushLoop is assembling a fused frame: stage, don't send.
@@ -615,7 +666,11 @@ void BytePSWorker::SendPush(PushOp op) {
         ph.key = p->key;
         ph.dtype = ctx->dtype;
         ph.version = version;
-        ph.flags = flags & FLAG_ASYNC;
+        // FLAG_WIRE_QUANT on a pull REQUESTS the server's re-quantized
+        // aggregate (the reply leg of the quantized wire); the response
+        // declares its own encoding, so a raw reply (reseeded slot,
+        // async param) is still handled below.
+        ph.flags = flags & (FLAG_ASYNC | FLAG_WIRE_QUANT);
         int64_t t_pull = NowUs();
         int pull_rid = kv_->Request(
             p->server_id, ph, nullptr, 0,
@@ -671,6 +726,23 @@ void BytePSWorker::SendPush(PushOp op) {
                     reinterpret_cast<float*>(base), p->len);
                 BPS_METRIC_HISTO_OBSERVE("bps_decompress_us",
                                          NowUs() - t_dec);
+              } else if (resp.head.flags & FLAG_WIRE_QUANT) {
+                // Quantized reply: dequantize the aggregate straight
+                // into the caller's buffer.
+                BPS_CHECK_EQ(resp.head.arg0, raw_len)
+                    << "quant pull length mismatch for key " << p->key;
+                BPS_CHECK(BlockQuant::Decode(
+                    resp.payload.data(),
+                    static_cast<int64_t>(resp.payload.size()),
+                    reinterpret_cast<float*>(base), p->len))
+                    << "malformed quantized pull reply for key "
+                    << p->key;
+                BPS_METRIC_COUNTER_ADD(
+                    "bps_quant_bytes_on_wire_total",
+                    static_cast<int64_t>(resp.payload.size()));
+                BPS_METRIC_COUNTER_ADD(
+                    "bps_quant_bytes_saved_total",
+                    raw_len - static_cast<int64_t>(resp.payload.size()));
               } else {
                 BPS_CHECK_EQ(
                     static_cast<int64_t>(resp.payload.size()), raw_len)
@@ -742,6 +814,14 @@ void BytePSWorker::SendFusedPush(int server_id, std::vector<PushOp> ops) {
     SubHeader& s = table[i];
     s.key = op.p->key;
     s.cmd = CMD_PUSH;
+    // Wire-dtype of the sub-payload: BPS_INT8 marks the block-quantized
+    // encoding (FLAG_WIRE_QUANT rides in flags too — the engine-side
+    // dequant keys on the flag, the table field is the wire contract
+    // HandleMulti validates). Default 0 = raw float32/`dtype` bytes, so
+    // a quant-off frame is byte-for-byte the pre-quant wire.
+    s.wire_dtype = (op.flags & FLAG_WIRE_QUANT)
+                       ? static_cast<int16_t>(BPS_INT8)
+                       : static_cast<int16_t>(0);
     s.version = op.version;
     s.dtype = op.ctx->dtype;
     s.flags = op.flags;
@@ -842,7 +922,14 @@ void BytePSWorker::OnFusedAck(
     s.cmd = CMD_PULL;
     s.version = op.version;
     s.dtype = op.ctx->dtype;
-    s.flags = op.flags & FLAG_ASYNC;
+    // FLAG_WIRE_QUANT requests the re-quantized aggregate for keys this
+    // worker pushed quantized (see the single-frame pull's comment);
+    // wire_dtype mirrors it (the REQUESTED reply encoding — a pull has
+    // no payload of its own).
+    s.flags = op.flags & (FLAG_ASYNC | FLAG_WIRE_QUANT);
+    s.wire_dtype = (s.flags & FLAG_WIRE_QUANT)
+                       ? static_cast<int16_t>(BPS_INT8)
+                       : static_cast<int16_t>(0);
   }
   // Whole batch acknowledged -> one fused pull for the aggregates.
   MsgHeader h{};
@@ -921,6 +1008,16 @@ void BytePSWorker::OnFusedPullResp(
       op.p->comp->Decompress(data, s.len,
                              reinterpret_cast<float*>(op.base), op.p->len);
       BPS_METRIC_HISTO_OBSERVE("bps_decompress_us", NowUs() - t_dec);
+    } else if (s.flags & FLAG_WIRE_QUANT) {
+      BPS_CHECK_EQ(s.arg0, op.raw_len)
+          << "quant pull length mismatch for key " << op.p->key;
+      BPS_CHECK(BlockQuant::Decode(data, s.len,
+                                   reinterpret_cast<float*>(op.base),
+                                   op.p->len))
+          << "malformed quantized pull reply for key " << op.p->key;
+      BPS_METRIC_COUNTER_ADD("bps_quant_bytes_on_wire_total", s.len);
+      BPS_METRIC_COUNTER_ADD("bps_quant_bytes_saved_total",
+                             op.raw_len - s.len);
     } else {
       BPS_CHECK_EQ(s.len, op.raw_len)
           << "pull length mismatch for key " << op.p->key;

@@ -142,6 +142,19 @@ class BytePSWorker {
     int rec_stage = 0;
     int rec_push_rid = -1;
     PushOp rec_op;
+    // Quantized wire state (ISSUE 6, BYTEPS_WIRE_QUANT). qresidual is
+    // the per-key push-leg error-feedback carry: residual += grad,
+    // encode(residual), residual -= decode(encoded) — so the int8
+    // rounding error of round r rides into round r+1's encode and the
+    // EF trajectory tracks dense. It lives HERE (worker-resident, one
+    // float per element whenever quant is armed — the same memory
+    // class as reseed_data) precisely so it survives a server death:
+    // recovery re-pushes ship the already-encoded snapshot and the
+    // residual stream stays bit-identical to the fault-free run.
+    // qbuf is the encoded payload; like comp_buf it is pinned until
+    // the handle settles (fused frames gather from it zero-copy).
+    std::vector<float> qresidual;
+    std::vector<char> qbuf;
     // Last completed round's unscaled aggregate — the re-seed payload.
     // Costs ~one gradient-sized buffer per worker whenever recovery is
     // armed (documented under BYTEPS_RECOVERY_TIMEOUT_MS in
@@ -166,6 +179,15 @@ class BytePSWorker {
   };
 
   void PushLoop();
+  // True when a partition ships the block-quantized wire encoding:
+  // quant armed, float32, and at least the minimum raw size (below it
+  // the per-block scale overhead isn't worth the framing). Callers
+  // additionally require the key to be codec-less (p->comp == nullptr)
+  // — a compressed payload is already encoded freight.
+  bool QuantEligible(const TensorCtx* ctx, int64_t raw_len) const {
+    return wire_quant_ && ctx->dtype == BPS_FLOAT32 &&
+           raw_len >= quant_min_bytes_;
+  }
   // Span into the shared main trace ring (trace.h); `round`/`peer`/`req`
   // feed the merge tool's stage attribution and flow stitching.
   void Record(int64_t key, const char* stage, int64_t start_us,
@@ -220,6 +242,13 @@ class BytePSWorker {
   int64_t fusion_bytes_ = 0;  // 0 = fusion off
   int fusion_keys_ = 128;
   int64_t fusion_linger_us_ = 200;  // BYTEPS_FUSION_LINGER_US
+  // Block-quantized wire (ISSUE 6): BYTEPS_WIRE_QUANT arms int8
+  // encoding (+ worker-side EF residuals) for codec-less float32
+  // partitions of at least quant_min_bytes_ raw bytes; the pull leg
+  // requests the server's re-quantized aggregate for the same keys.
+  bool wire_quant_ = false;          // BYTEPS_WIRE_QUANT
+  int quant_block_ = 64;             // BYTEPS_WIRE_QUANT_BLOCK
+  int64_t quant_min_bytes_ = 1024;   // BYTEPS_WIRE_QUANT_MIN_BYTES
   std::string default_comp_;
   bool trace_on_ = false;
 

@@ -86,6 +86,15 @@ def _load() -> ctypes.CDLL:
     lib.bps_reducer_bench.argtypes = [ctypes.c_longlong, ctypes.c_int,
                                       ctypes.c_int]
     lib.bps_reducer_bench.restype = ctypes.c_double
+    # Codec roundtrip probes (no topology): property tests for the
+    # compressor plugins and the BlockQuant wire codec (ISSUE 6).
+    lib.bps_compressor_roundtrip.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_longlong,
+        ctypes.c_void_p]
+    lib.bps_compressor_roundtrip.restype = ctypes.c_longlong
+    lib.bps_quant_roundtrip.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int, ctypes.c_void_p]
+    lib.bps_quant_roundtrip.restype = ctypes.c_longlong
     # One telemetry surface (byteps_tpu.monitor): the snapshot absorbs
     # the former bps_net_bytes / bps_async_staleness / bps_dead_nodes
     # ad-hoc diagnostics — net_bytes()/async_staleness()/dead_nodes()
@@ -141,6 +150,43 @@ def reducer_bench(nbytes: int = 64 << 20, iters: int = 20,
     return gbps
 
 
+def compressor_roundtrip(config: str, src: np.ndarray):
+    """Encode `src` (float32) with the C-core codec built from `config`
+    and decode it back. Returns (encoded_bytes, decoded array). Raises
+    ValueError on a bad config and FloatingPointError on NaN/Inf input
+    — the C core refuses to encode garbage ("error loudly")."""
+    src = np.ascontiguousarray(src, dtype=np.float32)
+    dst = np.empty_like(src)
+    rc = int(_load().bps_compressor_roundtrip(
+        config.encode(), src.ctypes.data_as(ctypes.c_void_p), src.size,
+        dst.ctypes.data_as(ctypes.c_void_p)))
+    if rc == -2:
+        raise FloatingPointError(
+            "non-finite value in compressor input (refused to encode)")
+    if rc < 0:
+        raise ValueError(f"bad compressor config {config!r}")
+    return rc, dst
+
+
+def quant_roundtrip(src: np.ndarray, block: int = 64):
+    """BlockQuant (ISSUE 6 wire codec) roundtrip: returns
+    (encoded_bytes, decoded array). Raises ValueError on an invalid
+    block and FloatingPointError on NaN/Inf input."""
+    src = np.ascontiguousarray(src, dtype=np.float32)
+    dst = np.empty_like(src)
+    rc = int(_load().bps_quant_roundtrip(
+        src.ctypes.data_as(ctypes.c_void_p), src.size, int(block),
+        dst.ctypes.data_as(ctypes.c_void_p)))
+    if rc == -2:
+        raise FloatingPointError(
+            "non-finite value in quantizer input (refused to encode)")
+    if rc < 0:
+        raise ValueError(
+            f"invalid block {block} (power of two in [16, 32768]) or "
+            "empty input")
+    return rc, dst
+
+
 def _apply_config_env(cfg: Optional[Config]) -> None:
     """Project a Config back into the env the C core reads (the C side is
     env-configured for parity with the reference)."""
@@ -155,6 +201,12 @@ def _apply_config_env(cfg: Optional[Config]) -> None:
     os.environ["BYTEPS_FUSION_BYTES"] = str(cfg.fusion_bytes)
     os.environ["BYTEPS_FUSION_KEYS"] = str(cfg.fusion_keys)
     os.environ["BYTEPS_FUSION_LINGER_US"] = str(cfg.fusion_linger_us)
+    # Block-quantized wire (ISSUE 6): worker AND server read these, so
+    # both ends compute identical per-key eligibility.
+    os.environ["BYTEPS_WIRE_QUANT"] = "1" if cfg.wire_quant else "0"
+    os.environ["BYTEPS_WIRE_QUANT_BLOCK"] = str(cfg.wire_quant_block)
+    os.environ["BYTEPS_WIRE_QUANT_MIN_BYTES"] = str(
+        cfg.wire_quant_min_bytes)
     os.environ["BYTEPS_SERVER_ENGINE_THREAD"] = str(cfg.server_engine_threads)
     os.environ["BYTEPS_ENABLE_ASYNC"] = "1" if cfg.enable_async else "0"
     if cfg.compressor:

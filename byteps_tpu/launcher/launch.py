@@ -291,6 +291,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "fleet (BYTEPS_FUSION_BYTES): partitions under N "
                         "raw bytes coalesce into multi-key wire frames; "
                         "0 disables fusion (default: inherit env, 65536)")
+    p.add_argument("--wire-quant", action="store_true",
+                   help="arm the block-quantized wire for the whole "
+                        "fleet (BYTEPS_WIRE_QUANT=1): codec-less "
+                        "float32 partitions ship as per-block int8 with "
+                        "worker-side error feedback, ~3.8x fewer wire "
+                        "bytes each way (docs/performance.md 'Quantized "
+                        "wire'); tune with BYTEPS_WIRE_QUANT_BLOCK / "
+                        "BYTEPS_WIRE_QUANT_MIN_BYTES")
     p.add_argument("--trace-dir", metavar="DIR", default="",
                    help="arm fleet-wide distributed tracing "
                         "(BYTEPS_TRACE_ON=1, BYTEPS_TRACE_DIR=DIR): "
@@ -346,6 +354,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"{args.trace_dir}`", file=sys.stderr)
     if args.fusion_bytes >= 0:
         os.environ["BYTEPS_FUSION_BYTES"] = str(args.fusion_bytes)
+    if args.wire_quant:
+        os.environ["BYTEPS_WIRE_QUANT"] = "1"
     if args.chaos:
         chaos_envs = {"drop": "BYTEPS_CHAOS_DROP",
                       "dup": "BYTEPS_CHAOS_DUP",

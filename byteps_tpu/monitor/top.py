@@ -100,7 +100,18 @@ def analyze(scrapes: Dict[str, Optional[dict]],
             # BYTEPS_TRACE_RING_EVENTS or narrow the step window.
             "trace_dropped": int(_sample(m, "bps_trace_dropped_total")),
             "flight_dumps": int(_sample(m, "bps_flight_dumps_total")),
+            # Quantized wire (ISSUE 6): encoded bytes that crossed the
+            # wire and raw-minus-encoded savings, both legs. The
+            # compression ratio column is (wire + saved) / wire.
+            "quant_wire_bytes": int(
+                _sample(m, "bps_quant_bytes_on_wire_total")),
+            "quant_saved_bytes": int(
+                _sample(m, "bps_quant_bytes_saved_total")),
         }
+        qw = workers[name]["quant_wire_bytes"]
+        qs = workers[name]["quant_saved_bytes"]
+        workers[name]["quant_ratio"] = (
+            round((qw + qs) / qw, 2) if qw > 0 else 1.0)
 
     # A worker actively riding the retry layer is flagged separately
     # from stragglers: its latency may still look healthy while its
@@ -160,8 +171,8 @@ def _print_report(report: dict, as_json: bool) -> None:
         print(json.dumps(report))
         return
     print(f"{'worker':<10} {'push/s':>8} {'push MB':>9} {'pull MB':>9} "
-          f"{'mean push':>10} {'queue':>6} {'credit':>14} {'rtry':>5} "
-          f"{'reconn':>6} flags")
+          f"{'q-ratio':>7} {'mean push':>10} {'queue':>6} {'credit':>14} "
+          f"{'rtry':>5} {'reconn':>6} flags")
     if report.get("recovering"):
         print(f"fleet: RECOVERING (membership epoch {report['epoch']}; "
               "a server rank is being hot-replaced)")
@@ -183,8 +194,11 @@ def _print_report(report: dict, as_json: bool) -> None:
             flags.append(f"RECOVERED×{w['recoveries']}")
         credit = (f"{w['inflight_bytes'] >> 10}/"
                   f"{w['credit_budget_bytes'] >> 10}K")
+        qratio = (f"{w['quant_ratio']:.1f}x"
+                  if w.get("quant_wire_bytes") else "-")
         print(f"{name:<10} {w['push_count']:>8} "
               f"{w['push_bytes'] / 1e6:>9.2f} {w['pull_bytes'] / 1e6:>9.2f} "
+              f"{qratio:>7} "
               f"{w['push_mean_us'] / 1e3:>8.2f}ms {w['queue_pending']:>6} "
               f"{credit:>14} {w.get('retries', 0):>5} "
               f"{w.get('reconnects', 0):>6} {' '.join(flags)}")

@@ -613,6 +613,114 @@ def main() -> int:
                 w.wait(h)
                 np.testing.assert_array_equal(arr, expect)
 
+        elif mode == "quant":
+            # Block-quantized wire acceptance (ISSUE 6): a mixed-size
+            # multi-round workload with BYTEPS_WIRE_QUANT set by the
+            # parent. Keys at or above BYTEPS_WIRE_QUANT_MIN_BYTES ship
+            # int8-encoded (verified within EF tolerance of the exact
+            # dense aggregate); keys below it — and one lossless-codec
+            # key, proving codec keys skip quant — stay EXACT. The
+            # digest over every final buffer is the cross-run oracle:
+            # the quantized wire is deterministic, so chaos / recovery
+            # variants must reproduce the fault-free quant run bitwise.
+            import hashlib
+            import json
+            import urllib.request
+
+            from byteps_tpu.monitor.metrics import parse_prometheus
+
+            quant_on = os.environ.get(
+                "BYTEPS_WIRE_QUANT", "") not in ("", "0")
+            min_bytes = int(os.environ.get(
+                "BYTEPS_WIRE_QUANT_MIN_BYTES", "1024"))
+            # 256 B .. 12 KiB raw: both sides of the default 1 KiB
+            # min-bytes gate, fused and singleton flushes.
+            sizes = [64, 96, 128, 192, 256, 384, 512, 768, 1024, 1536,
+                     2048, 3072] * 4  # 48 tensors
+            tids = [w.declare(f"qt{i}", n, "float32", compression="")
+                    for i, n in enumerate(sizes)]
+            # Lossless per-tensor codec key: topk with k=n roundtrips
+            # exactly AND must bypass the quantized wire (codec keys
+            # ship compressor bytes).
+            ck = w.declare("qt_comp", 512, "float32",
+                           compression="type=topk;k=512")
+            digest = hashlib.sha256()
+            scale = sum(r + 1 for r in range(nw))
+            for rnd in range(3):
+                staged = []
+                for i, (tid, n) in enumerate(zip(tids, sizes)):
+                    base = (np.arange(n) % 97 + i + rnd + 1).astype(
+                        np.float32)
+                    arr = np.ascontiguousarray(base * (rank + 1))
+                    staged.append((w.push_pull(tid, arr, average=False),
+                                   arr, base, n))
+                cbase = (np.arange(512) % 41 + rnd + 1).astype(np.float32)
+                carr = np.ascontiguousarray(cbase * (rank + 1))
+                ch = w.push_pull(ck, carr, average=False)
+                for h, arr, base, n in staged:
+                    w.wait(h)
+                    expect = base * scale
+                    if quant_on and n * 4 >= min_bytes:
+                        # EF tolerance: per push, the int8 rounding
+                        # error is at most absmax/254 per element (per
+                        # block), the EF residual carries at most one
+                        # more step, and the re-quantized reply adds
+                        # one step of the aggregate — comfortably
+                        # inside 3% of the aggregate's magnitude, and
+                        # orders of magnitude tighter than any
+                        # double-apply or mis-decode bug.
+                        tol = float(np.abs(expect).max()) * 0.03 + 1e-3
+                        np.testing.assert_allclose(arr, expect, rtol=0,
+                                                   atol=tol)
+                    else:
+                        np.testing.assert_array_equal(arr, expect)
+                    digest.update(arr.tobytes())
+                w.wait(ch)
+                np.testing.assert_array_equal(carr, cbase * scale)
+                digest.update(carr.tobytes())
+            w.barrier(GROUP_WORKERS)  # all counters final
+            snap = w.metrics_snapshot()["counters"]
+            parity = None
+            mport = int(os.environ.get("BYTEPS_MONITOR_PORT", "0"))
+            if rank == 0 and mport:
+                # Push-byte parity under quant: both sides must count
+                # ENCODED wire bytes (the PR 2 contract, re-proven on
+                # the quantized wire).
+                ns = int(os.environ["DMLC_NUM_SERVER"])
+
+                def scrape(port):
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/metrics",
+                            timeout=5) as r:
+                        return parse_prometheus(r.read().decode())
+
+                worker_push = sum(
+                    scrape(mport + 1 + ns + r)["bps_push_bytes_total"][()]
+                    for r in range(nw))
+                server_recv = sum(
+                    scrape(mport + 1 + s)["bps_recv_bytes_total"][()]
+                    for s in range(ns))
+                assert worker_push == server_recv, (worker_push,
+                                                    server_recv)
+                parity = [worker_push, server_recv]
+            print(json.dumps({
+                "digest": digest.hexdigest(),
+                "quant_wire": snap.get("bps_quant_bytes_on_wire_total",
+                                       0),
+                "quant_saved": snap.get("bps_quant_bytes_saved_total",
+                                        0),
+                "push_bytes": snap.get("bps_push_bytes_total", 0),
+                "push_partitions": snap.get("bps_push_partitions_total",
+                                            0),
+                "fused": snap.get("bps_fused_msgs_total", 0),
+                "retries": snap.get("bps_retries_total", 0),
+                "chaos_injected": snap.get("bps_chaos_injected_total",
+                                           0),
+                "parity": parity,
+            }), flush=True)
+            # Hold the fleet until rank 0 finished scraping everyone.
+            w.barrier(GROUP_WORKERS)
+
         elif mode == "chaos":
             # Transient-fault tolerance acceptance (ISSUE 3): a
             # multi-round, many-tensor training-shaped workload that the
@@ -697,6 +805,12 @@ def main() -> int:
             scale = sum(r + 1 for r in range(nw))
             rounds = int(os.environ.get("BPS_TEST_ROUNDS", "8"))
             sleep_s = float(os.environ.get("BPS_TEST_ROUND_SLEEP", "0.3"))
+            # Under the quantized wire (ISSUE 6 recovery composition)
+            # aggregates are exact-to-EF-tolerance rather than exact;
+            # the DIGEST stays the bit-identity oracle across the
+            # fault-free / kill-one-server variants.
+            quant_on = os.environ.get(
+                "BYTEPS_WIRE_QUANT", "") not in ("", "0")
             for rnd in range(rounds):
                 staged = []
                 for i, (tid, n) in enumerate(zip(tids, sizes)):
@@ -707,7 +821,13 @@ def main() -> int:
                                    arr, base))
                 for h, arr, base in staged:
                     w.wait(h)
-                    np.testing.assert_array_equal(arr, base * scale)
+                    if quant_on:
+                        expect = base * scale
+                        tol = float(np.abs(expect).max()) * 0.03 + 1e-3
+                        np.testing.assert_allclose(arr, expect, rtol=0,
+                                                   atol=tol)
+                    else:
+                        np.testing.assert_array_equal(arr, base * scale)
                     digest.update(arr.tobytes())
                 print(f"round {rnd}", flush=True)
                 _t.sleep(sleep_s)

@@ -1,0 +1,178 @@
+"""Property/roundtrip tests for the C-core codecs (ISSUE 6 satellite).
+
+The compressor plugins (onebit / topk / randomk / dithering) and the new
+BlockQuant wire codec are exercised straight through the FFI probes
+(bps_compressor_roundtrip / bps_quant_roundtrip) — no topology, fast
+tier. The contract under test: odd lengths and non-multiple-of-block
+tails roundtrip, all-zero blocks decode to exact zeros, and NaN/Inf
+inputs error LOUDLY instead of encoding garbage (the probes return an
+error the bindings raise on; the in-core push path CHECK-crashes on the
+same condition).
+"""
+
+import numpy as np
+import pytest
+
+from byteps_tpu.core import ffi
+
+RNG = np.random.default_rng(7)
+
+
+# --- BlockQuant wire codec --------------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 5, 17, 63, 64, 65, 100, 1023, 4097])
+@pytest.mark.parametrize("block", [16, 64, 1024])
+def test_quant_roundtrip_error_bound(n, block):
+    """Any length — including tails shorter than one block — roundtrips
+    with per-element error at most half a quantization step of its OWN
+    block (absmax/254), and the encoded size matches the documented
+    layout: 8-byte header + one f32 scale per block + n int8 codes."""
+    x = (RNG.standard_normal(n) * 10).astype(np.float32)
+    enc, dec = ffi.quant_roundtrip(x, block)
+    nblocks = -(-n // block)
+    assert enc == 8 + 4 * nblocks + n
+    for b in range(nblocks):
+        lo, hi = b * block, min((b + 1) * block, n)
+        step = np.abs(x[lo:hi]).max() / 127.0
+        assert np.abs(dec[lo:hi] - x[lo:hi]).max() <= step / 2 + 1e-6
+
+
+def test_quant_all_zero_blocks_decode_exact_zeros():
+    """A zero block encodes scale 0 and must decode to EXACT zeros (no
+    0 * garbage NaN propagation); mixed zero/nonzero blocks keep the
+    nonzero blocks' precision."""
+    z = np.zeros(200, np.float32)
+    _, dec = ffi.quant_roundtrip(z, 16)
+    assert (dec == 0.0).all()
+    x = np.zeros(128, np.float32)
+    x[64:] = np.linspace(-3, 3, 64, dtype=np.float32)
+    _, dec = ffi.quant_roundtrip(x, 64)
+    assert (dec[:64] == 0.0).all()
+    assert np.abs(dec[64:] - x[64:]).max() <= 3.0 / 254 + 1e-6
+
+
+def test_quant_extremes_roundtrip():
+    """Block absmax values map to exactly ±127 codes — the endpoints
+    reconstruct exactly; subnormal-scale blocks stay finite."""
+    x = np.array([-8.0, 8.0, 4.0, -4.0] * 8, np.float32)
+    _, dec = ffi.quant_roundtrip(x, 16)
+    np.testing.assert_allclose(dec[x == 8.0], 8.0, rtol=0)
+    np.testing.assert_allclose(dec[x == -8.0], -8.0, rtol=0)
+    tiny = np.full(32, 1e-38, np.float32)
+    _, dec = ffi.quant_roundtrip(tiny, 16)
+    assert np.isfinite(dec).all()
+
+
+def test_quant_compression_ratio_approaches_4x():
+    """The headline: ~4x fewer encoded bytes than raw float32 on real-
+    size payloads (the per-block f32 scale costs 1/block extra)."""
+    n = 1 << 16
+    x = RNG.standard_normal(n).astype(np.float32)
+    enc, _ = ffi.quant_roundtrip(x, 64)
+    assert 3.5 < 4.0 * n / enc <= 4.0
+
+
+@pytest.mark.parametrize("bad", [0, 1, 8, 15, 48, 100, 65536, -16])
+def test_quant_invalid_block_rejected(bad):
+    with pytest.raises(ValueError):
+        ffi.quant_roundtrip(np.ones(64, np.float32), bad)
+
+
+@pytest.mark.parametrize("poison", [np.nan, np.inf, -np.inf])
+def test_quant_non_finite_errors_loudly(poison):
+    x = np.ones(64, np.float32)
+    x[17] = poison
+    with pytest.raises(FloatingPointError):
+        ffi.quant_roundtrip(x, 16)
+
+
+def test_quant_deterministic():
+    """Same input, same encoding — resends and chaos replays must ship
+    identical bytes for the bit-identity contracts to hold (no RNG, no
+    rounding-mode sensitivity in practice)."""
+    x = RNG.standard_normal(1000).astype(np.float32)
+    _, a = ffi.quant_roundtrip(x, 64)
+    _, b = ffi.quant_roundtrip(x, 64)
+    np.testing.assert_array_equal(a, b)
+
+
+# --- compressor plugins -----------------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 3, 8, 9, 31, 257, 1000])
+def test_onebit_roundtrip_shapes(n):
+    """Odd lengths (including sub-byte sign tails) decode every element
+    to ±mean(|x|)."""
+    x = RNG.standard_normal(n).astype(np.float32)
+    _, dec = ffi.compressor_roundtrip("type=onebit", x)
+    scale = np.abs(x).mean(dtype=np.float64)
+    np.testing.assert_allclose(np.abs(dec), scale, rtol=1e-5)
+    signs_match = np.sign(dec) == np.where(x >= 0, 1.0, -1.0)
+    assert signs_match.all()
+
+
+def test_topk_keeps_largest_exactly():
+    x = RNG.standard_normal(100).astype(np.float32)
+    enc, dec = ffi.compressor_roundtrip("type=topk;k=10", x)
+    top = np.argsort(-np.abs(x))[:10]
+    np.testing.assert_array_equal(dec[top], x[top])
+    mask = np.ones(100, bool)
+    mask[top] = False
+    assert (dec[mask] == 0.0).all()
+    assert enc == 4 + 10 * 8
+
+
+def test_topk_k_larger_than_n():
+    """k is clamped to n: the whole tensor roundtrips losslessly."""
+    x = RNG.standard_normal(7).astype(np.float32)
+    _, dec = ffi.compressor_roundtrip("type=topk;k=100", x)
+    np.testing.assert_array_equal(dec, x)
+
+
+def test_randomk_samples_exact_values_deterministically():
+    """randomk keeps k exact source values at distinct indices, and a
+    fixed seed makes the selection reproducible (chaos replays of a
+    compressed push must ship identical bytes)."""
+    x = RNG.standard_normal(200).astype(np.float32)
+    _, d1 = ffi.compressor_roundtrip("type=randomk;k=20;seed=5", x)
+    _, d2 = ffi.compressor_roundtrip("type=randomk;k=20;seed=5", x)
+    np.testing.assert_array_equal(d1, d2)
+    kept = np.flatnonzero(d1)
+    assert 0 < len(kept) <= 20
+    np.testing.assert_array_equal(d1[kept], x[kept])
+
+
+def test_dithering_unbiased_roundtrip():
+    x = (RNG.standard_normal(512) * 3).astype(np.float32)
+    _, dec = ffi.compressor_roundtrip("type=dithering;seed=3", x)
+    step = np.abs(x).max() / 127.0
+    # Stochastic rounding: each element lands on one of its two
+    # neighbouring levels.
+    assert np.abs(dec - x).max() <= step + 1e-6
+
+
+def test_error_feedback_decorator_roundtrips():
+    x = RNG.standard_normal(64).astype(np.float32)
+    _, dec = ffi.compressor_roundtrip("type=onebit;ef=vanilla", x)
+    assert np.isfinite(dec).all()
+
+
+@pytest.mark.parametrize("cfg", ["type=onebit", "type=topk;k=4",
+                                 "type=randomk;k=4;seed=1",
+                                 "type=dithering"])
+@pytest.mark.parametrize("poison", [np.nan, np.inf])
+def test_compressors_refuse_non_finite(cfg, poison):
+    """The satellite's contract for EVERY lossy codec: a NaN/Inf
+    gradient must error loudly, never encode garbage (onebit's mean
+    scale would go NaN, topk would sort the Inf to the front,
+    dithering would divide by it)."""
+    x = RNG.standard_normal(32).astype(np.float32)
+    x[5] = poison
+    with pytest.raises(FloatingPointError):
+        ffi.compressor_roundtrip(cfg, x)
+
+
+def test_unknown_compressor_config_rejected():
+    with pytest.raises(ValueError):
+        ffi.compressor_roundtrip("type=zstd", np.ones(8, np.float32))
+    with pytest.raises(ValueError):
+        ffi.compressor_roundtrip("", np.ones(8, np.float32))

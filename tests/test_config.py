@@ -253,3 +253,62 @@ def test_recovery_env_roundtrip(monkeypatch):
     assert cfg.recover_rank == 1
     monkeypatch.delenv("DMLC_RECOVER_RANK")
     assert load_config().recover_rank is None
+
+
+def test_wire_quant_defaults_and_env(monkeypatch):
+    """Block-quantized wire knobs (ISSUE 6): off by default (the wire is
+    then byte-for-byte the pre-quant protocol), env override works, and
+    the values project back into the env the C core reads."""
+    for var in ("BYTEPS_WIRE_QUANT", "BYTEPS_WIRE_QUANT_BLOCK",
+                "BYTEPS_WIRE_QUANT_MIN_BYTES"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = load_config()
+    assert cfg.wire_quant is False
+    assert cfg.wire_quant_block == 64
+    assert cfg.wire_quant_min_bytes == 1024
+    monkeypatch.setenv("BYTEPS_WIRE_QUANT", "1")
+    monkeypatch.setenv("BYTEPS_WIRE_QUANT_BLOCK", "256")
+    monkeypatch.setenv("BYTEPS_WIRE_QUANT_MIN_BYTES", "4096")
+    cfg = load_config()
+    assert cfg.wire_quant is True
+    assert cfg.wire_quant_block == 256
+    assert cfg.wire_quant_min_bytes == 4096
+    import os
+
+    from byteps_tpu.core.ffi import _apply_config_env
+    _apply_config_env(cfg)
+    assert os.environ["BYTEPS_WIRE_QUANT"] == "1"
+    assert os.environ["BYTEPS_WIRE_QUANT_BLOCK"] == "256"
+    assert os.environ["BYTEPS_WIRE_QUANT_MIN_BYTES"] == "4096"
+
+
+def test_wire_quant_block_validation():
+    """Block must be a power of two in [16, 32768] — the decode path
+    rejects any other geometry as a malformed frame, so the config must
+    refuse it before it ever reaches a wire."""
+    for bad in (0, 1, 8, 15, 48, 100, 65536, -16):
+        with pytest.raises(ValueError, match="BYTEPS_WIRE_QUANT_BLOCK"):
+            Config(wire_quant_block=bad).validate()
+    for ok in (16, 64, 1024, 32768):
+        Config(wire_quant_block=ok).validate()
+    with pytest.raises(ValueError, match="BYTEPS_WIRE_QUANT_MIN_BYTES"):
+        Config(wire_quant_min_bytes=-1).validate()
+
+
+def test_wire_quant_compressor_conflict_rejected():
+    """BYTEPS_WIRE_QUANT operates on raw float32 payloads; a fleet-wide
+    codec puts compressor bytes on every key, so quant would silently
+    never engage — the contradiction must fail validation (per-tensor
+    compression overrides remain the composing escape hatch)."""
+    with pytest.raises(ValueError, match="BYTEPS_WIRE_QUANT"):
+        Config(wire_quant=True, compressor="type=onebit").validate()
+    Config(wire_quant=True).validate()  # quant alone is fine
+    Config(compressor="type=onebit").validate()  # codec alone is fine
+
+
+def test_wire_quant_async_warns():
+    """quant + async is legal but the server accumulator integrates
+    lossy deltas with no round boundary for EF to true up against —
+    warn loudly."""
+    with pytest.warns(UserWarning, match="BYTEPS_WIRE_QUANT"):
+        Config(wire_quant=True, enable_async=True).validate()

@@ -1,0 +1,171 @@
+"""Block-quantized fused wire tests (ISSUE 6).
+
+Acceptance shape:
+  - a quant-on 2w x 2s fleet run completes with aggregates matching the
+    exact dense sums within EF tolerance (asserted in-worker), with the
+    push-byte parity contract holding over ENCODED bytes and a ~3.5-4x
+    wire-byte reduction on eligible keys;
+  - the quantized wire is DETERMINISTIC: chaos (drop/dup) and
+    kill-one-server recovery runs reproduce the fault-free quant-on
+    run's digests bitwise (resends ship snapshot bytes, the server's
+    cached per-round reply encode serves every replay, re-seeds carry
+    the authoritative float32 aggregate);
+  - BYTEPS_WIRE_QUANT=0 stays byte-for-byte today's wire — that half is
+    pinned by the existing fusion/chaos/recovery suites running
+    unchanged with the default-off knob.
+"""
+
+import json
+import os
+import random
+import socket
+
+import pytest
+
+from tests.ps_utils import run_topology
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_ps_worker.py")
+
+pytestmark = [pytest.mark.ps, pytest.mark.quant]
+
+
+def _port_block(n):
+    """A base port with n consecutive free ports (monitor endpoints)."""
+    rng = random.Random()
+    for _ in range(50):
+        cand = rng.randrange(20000, 55000)
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", cand + i))
+                socks.append(s)
+            return cand
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise AssertionError("no free port block found")
+
+
+def _run_quant_topology(quant: bool, extra=None, monitor=False):
+    env = {"BYTEPS_WIRE_QUANT": "1" if quant else "0",
+           "BYTEPS_RETRY_TIMEOUT_MS": "200",
+           "BYTEPS_RECONNECT_BACKOFF_MS": "50"}
+    if monitor:
+        base = _port_block(5)
+        env.update({"BYTEPS_MONITOR_ON": "1",
+                    "BYTEPS_MONITOR_PORT": str(base)})
+    env.update(extra or {})
+    outs = run_topology(2, 2, WORKER, mode="quant", extra=env,
+                        timeout=150.0)
+    rows = [json.loads(ln) for o in outs for ln in o.splitlines()
+            if ln.startswith("{")]
+    assert len(rows) == 2, outs
+    return rows
+
+
+def test_quant_on_matches_dense_within_ef_tolerance_with_parity():
+    """The quant-on fleet run: eligible keys' aggregates within EF
+    tolerance of the exact dense sums and sub-min-bytes/codec keys
+    exact (both asserted in-worker), the worker/server push-byte parity
+    contract holding over ENCODED bytes (scraped in-worker from real
+    /metrics endpoints), and the encoded-byte savings in the new
+    bps_quant_* counters at roughly the codec's 4x."""
+    rows = _run_quant_topology(quant=True, monitor=True)
+    assert all(r["quant_wire"] > 0 for r in rows), rows
+    assert all(r["quant_saved"] > 0 for r in rows), rows
+    # Both workers agree bitwise (same decode of the same replies).
+    assert len({r["digest"] for r in rows}) == 1, rows
+    # Parity was scraped and held (rank 0 asserts the equality).
+    assert any(r["parity"] for r in rows), rows
+    # Wire ratio on the quantized traffic: (wire + saved) / wire is the
+    # codec's raw/encoded ratio, ~3.8x at block 64 (header + scales
+    # overhead keeps it under 4).
+    for r in rows:
+        ratio = (r["quant_wire"] + r["quant_saved"]) / r["quant_wire"]
+        assert 3.0 < ratio <= 4.0, (ratio, r)
+    # Encoded bytes actually shrank the push wire: raw would be
+    # push_partitions-proportional; just sanity-check the counted push
+    # bytes are well under the raw total implied by quant_saved.
+    assert all(r["push_bytes"] < r["push_bytes"] + r["quant_saved"]
+               for r in rows)
+
+
+def test_quant_off_counters_zero_and_wire_unchanged():
+    """The off half of the bit-identity criterion: with the knob at its
+    default 0 the quant counters stay zero and aggregates are EXACT
+    (asserted in-worker) — the wire is the pre-quant protocol. (The
+    full regression surface is the existing fusion/chaos/recovery
+    suites, which run with quant off.)"""
+    rows = _run_quant_topology(quant=False)
+    assert all(r["quant_wire"] == 0 for r in rows), rows
+    assert all(r["quant_saved"] == 0 for r in rows), rows
+    assert len({r["digest"] for r in rows}) == 1, rows
+
+
+def test_quant_composes_with_striping_bit_identical():
+    """BYTEPS_VAN_STREAMS + quant: striping is connection-level and the
+    encoding payload-level — the same encodes must produce the same
+    aggregates bit for bit whichever stripe carried them (the fusion
+    collector still batches per (server, stripe), so per-key order
+    holds)."""
+    plain = _run_quant_topology(quant=True)
+    striped = _run_quant_topology(quant=True,
+                                  extra={"BYTEPS_VAN_STREAMS": "2"})
+    assert all(r["quant_wire"] > 0 for r in striped), striped
+    digests = ({r["digest"] for r in plain}
+               | {r["digest"] for r in striped})
+    assert len(digests) == 1, (plain, striped)
+
+
+def test_quant_composes_with_chaos_bit_identical():
+    """Chaos (drop/dup, fixed seed) under the quantized wire: resends
+    ship the snapshot-encoded bytes and the server's dedup window plus
+    cached per-round reply encode answer every replay, so the run is
+    BIT-IDENTICAL to its own fault-free quant-on run."""
+    clean = _run_quant_topology(quant=True)
+    chaotic = _run_quant_topology(quant=True, extra={
+        "BYTEPS_CHAOS_SEED": "42",
+        "BYTEPS_CHAOS_DROP": "0.03",
+        "BYTEPS_CHAOS_DUP": "0.03",
+    })
+    assert all(r["chaos_injected"] > 0 for r in chaotic), chaotic
+    assert sum(r["retries"] for r in chaotic) > 0, chaotic
+    digests = ({r["digest"] for r in clean}
+               | {r["digest"] for r in chaotic})
+    assert len(digests) == 1, (clean, chaotic)
+
+
+@pytest.mark.recovery
+def test_quant_composes_with_recovery_bit_identical():
+    """Kill-one-server hot replacement under the quantized wire: the
+    re-seed ships the authoritative float32 aggregate (never the lossy
+    encoding) and recovery re-pushes ship the already-encoded snapshot
+    bytes, so the recovered run reproduces the fault-free quant-on
+    recovery-mode run bitwise — the worker-side EF residuals live on
+    the workers and survive the server death."""
+    from tests.test_recovery import RECOVERY_ENV, _kill_and_recover_run
+
+    quant_env = dict(RECOVERY_ENV)
+    quant_env["BYTEPS_WIRE_QUANT"] = "1"
+
+    clean_env = dict(quant_env)
+    clean_env["BPS_TEST_ROUND_SLEEP"] = "0"
+    outs = run_topology(2, 2, WORKER, mode="recovery", extra=clean_env,
+                        timeout=180.0)
+    clean = [json.loads(ln) for o in outs for ln in o.splitlines()
+             if ln.startswith("{")]
+    assert len(clean) == 2, outs
+    assert all(r["recoveries"] == 0 for r in clean), clean
+    assert len({r["digest"] for r in clean}) == 1, clean
+
+    rows = _kill_and_recover_run(quant_env, respawn_delay_s=4.0)
+    assert all(r["recoveries"] == 1 for r in rows), rows
+    assert all(r["epoch"] == 1 for r in rows), rows
+    assert len({r["digest"] for r in rows}) == 1, rows
+    assert rows[0]["digest"] == clean[0]["digest"], (
+        "quant-on recovery diverged from the quant-on fault-free run",
+        rows, clean)

@@ -68,15 +68,28 @@ def worker_main(args) -> None:
     for _ in range(args.warmup):
         one_round()
     w.barrier()
+    c0 = w.metrics_snapshot()["counters"]
     t0 = time.perf_counter()
     for _ in range(args.rounds):
         one_round()
     dt = time.perf_counter() - t0
+    c1 = w.metrics_snapshot()["counters"]
+
+    def delta(name):
+        return int(c1.get(name, 0)) - int(c0.get(name, 0))
+
     print(json.dumps({
         "rank": w.worker_rank(),
         "rounds": args.rounds,
         "seconds": round(dt, 3),
         "steps_per_s": round(args.rounds / dt, 4),
+        # Encoded bytes this worker put on / pulled off the wire during
+        # the timed window — the r06 wire-encoding comparison reads
+        # these (push_bytes counts ENCODED payloads on both wires).
+        "push_bytes": delta("bps_push_bytes_total"),
+        "pull_bytes": delta("bps_pull_bytes_total"),
+        "quant_wire_bytes": delta("bps_quant_bytes_on_wire_total"),
+        "quant_saved_bytes": delta("bps_quant_bytes_saved_total"),
     }), flush=True)
     w.shutdown()
 
@@ -88,6 +101,16 @@ def main() -> None:
                    choices=["float32", "float16"],
                    help="declared wire dtype (float16 = the bf16-wire "
                         "practice for transformer loads)")
+    p.add_argument("--encodings", default="float32",
+                   help="comma-separated wire ENCODINGS to sweep at "
+                        "every point: float32 (today's raw wire) and/or "
+                        "int8-block (BYTEPS_WIRE_QUANT block-quantized "
+                        "payloads, ISSUE 6). 'float32,int8-block' emits "
+                        "the r06 quant-on/off comparison curves with "
+                        "encoded wire MB per point")
+    p.add_argument("--quant-block", type=int, default=64,
+                   help="BYTEPS_WIRE_QUANT_BLOCK for the int8-block "
+                        "encoding")
     p.add_argument("--nic-gbit", type=float, default=0.2,
                    help="per-worker NIC bandwidth to emulate; per-"
                         "connection pacing = nic/servers")
@@ -121,60 +144,109 @@ def main() -> None:
     bytes_per_el = 2 if args.wire == "float16" else 4
     grad_mb = sum(sizes) * bytes_per_el / 1e6
     sweep = [int(x) for x in args.sweep.split(",")]
+    encodings = [e.strip() for e in args.encodings.split(",") if e.strip()]
+    unknown = set(encodings) - {"float32", "int8-block"}
+    if unknown:
+        raise SystemExit(f"unknown wire encodings {sorted(unknown)} "
+                         "(choose from float32, int8-block)")
+    if "int8-block" in encodings and args.wire != "float32":
+        raise SystemExit("int8-block quantizes raw float32 payloads; "
+                         "--wire must stay float32 for that encoding")
     out = {
         "what": ("measured scaling curve: full PS fleet (partitioning + "
                  "priority + credits + C++ van) under kernel-paced "
                  "per-connection links; efficiency = steps/s vs the "
-                 "1-worker point"),
+                 "1-worker point. One curve per wire ENCODING: float32 "
+                 "(raw, today's wire) vs int8-block (BYTEPS_WIRE_QUANT "
+                 "per-block int8 + worker-side EF, ISSUE 6) at the SAME "
+                 "pacing — the bandwidth-bound regime where fewer "
+                 "encoded bytes ARE the speedup"),
         "model": args.model, "wire": args.wire,
         "grad_mb": round(grad_mb, 1),
         "nic_gbit_per_worker": args.nic_gbit,
         "compute_ms": args.compute_ms,
         "rounds": args.rounds, "warmup": args.warmup,
-        "points": [],
+        "quant_block": args.quant_block,
+        "curves": {},
     }
-    base = None
-    for n in sweep:
-        servers = max(1, round(args.servers_per_worker * n))
-        pace = int(args.nic_gbit * 1e9 / 8 / servers)
-        part = int(args.partition_mb * (1 << 20))
-        credit = (int(args.credit_mb * (1 << 20)) if args.credit_mb
-                  else 4 * part * servers)
-        env = {"BYTEPS_PACING_RATE": str(pace),
-               "BYTEPS_PARTITION_BYTES": str(part),
-               "BYTEPS_SCHEDULING_CREDIT": str(credit)}
-        _, snap = cpu_busy_since(None)
-        rc, recs = run_fleet(
-            n, servers,
-            [os.path.abspath(__file__), "--role", "worker",
-             "--model", args.model, "--wire", args.wire,
-             "--rounds", str(args.rounds), "--warmup", str(args.warmup),
-             "--compute-ms", str(args.compute_ms)],
-            env_extra=env)
-        busy, _ = cpu_busy_since(snap)
-        if rc != 0 or len(recs) != n:
-            raise SystemExit(f"N={n} run failed rc={rc} recs={len(recs)}")
-        sps = sum(r["steps_per_s"] for r in recs) / n
-        point = {
-            "workers": n, "servers": servers,
-            "pacing_bytes_per_conn": pace,
-            "partition_bytes": part, "credit_bytes": credit,
-            "steps_per_s": round(sps, 4),
-            "step_seconds": round(1.0 / sps, 3),
-            "cpu_busy": busy,
-            "host_bound": bool(busy and busy > 0.85),
+    for enc in encodings:
+        points = []
+        base = None
+        for n in sweep:
+            servers = max(1, round(args.servers_per_worker * n))
+            pace = int(args.nic_gbit * 1e9 / 8 / servers)
+            part = int(args.partition_mb * (1 << 20))
+            credit = (int(args.credit_mb * (1 << 20)) if args.credit_mb
+                      else 4 * part * servers)
+            env = {"BYTEPS_PACING_RATE": str(pace),
+                   "BYTEPS_PARTITION_BYTES": str(part),
+                   "BYTEPS_SCHEDULING_CREDIT": str(credit),
+                   "BYTEPS_WIRE_QUANT":
+                       "1" if enc == "int8-block" else "0",
+                   "BYTEPS_WIRE_QUANT_BLOCK": str(args.quant_block)}
+            _, snap = cpu_busy_since(None)
+            rc, recs = run_fleet(
+                n, servers,
+                [os.path.abspath(__file__), "--role", "worker",
+                 "--model", args.model, "--wire", args.wire,
+                 "--rounds", str(args.rounds),
+                 "--warmup", str(args.warmup),
+                 "--compute-ms", str(args.compute_ms)],
+                env_extra=env)
+            busy, _ = cpu_busy_since(snap)
+            if rc != 0 or len(recs) != n:
+                raise SystemExit(
+                    f"{enc} N={n} run failed rc={rc} recs={len(recs)}")
+            sps = sum(r["steps_per_s"] for r in recs) / n
+            # Encoded wire MB actually moved per ROUND, fleet-wide and
+            # per-leg (push_bytes counts encoded payloads either way).
+            push_mb = sum(r.get("push_bytes", 0) for r in recs) / 1e6
+            pull_mb = sum(r.get("pull_bytes", 0) for r in recs) / 1e6
+            point = {
+                "workers": n, "servers": servers,
+                "encoding": enc,
+                "pacing_bytes_per_conn": pace,
+                "partition_bytes": part, "credit_bytes": credit,
+                "steps_per_s": round(sps, 4),
+                "step_seconds": round(1.0 / sps, 3),
+                "wire_mb_per_round": round(
+                    (push_mb + pull_mb) / args.rounds, 2),
+                "push_mb_per_round": round(push_mb / args.rounds, 2),
+                "quant_saved_mb": round(sum(
+                    r.get("quant_saved_bytes", 0) for r in recs) / 1e6,
+                    2),
+                "cpu_busy": busy,
+                "host_bound": bool(busy and busy > 0.85),
+            }
+            if base is None:
+                base = sps
+            point["efficiency_vs_1"] = round(sps / base, 4)
+            points.append(point)
+            print(json.dumps(point), flush=True)
+        out["curves"][enc] = {"points": points}
+        print(json.dumps({
+            "metric": f"scaling_efficiency_{args.model}_{enc}",
+            "value": points[-1]["efficiency_vs_1"],
+            "unit": "x (steps/s at max workers vs 1 worker)",
+            "workers": sweep[-1],
+        }))
+    if len(encodings) == 2 and "int8-block" in out["curves"]:
+        f32 = out["curves"]["float32"]["points"][-1]
+        q = out["curves"]["int8-block"]["points"][-1]
+        out["summary"] = {
+            "workers": sweep[-1],
+            "speedup_int8_vs_float32": round(
+                q["steps_per_s"] / f32["steps_per_s"], 2),
+            "wire_mb_ratio_float32_vs_int8": round(
+                f32["wire_mb_per_round"] / q["wire_mb_per_round"], 2),
         }
-        if base is None:
-            base = sps
-        point["efficiency_vs_1"] = round(sps / base, 4)
-        out["points"].append(point)
-        print(json.dumps(point), flush=True)
-    print(json.dumps({
-        "metric": f"scaling_efficiency_{args.model}",
-        "value": out["points"][-1]["efficiency_vs_1"],
-        "unit": "x (steps/s at max workers vs 1 worker)",
-        "workers": sweep[-1],
-    }))
+        print(json.dumps({
+            "metric": "quant_wire_speedup_at_max_workers",
+            "value": out["summary"]["speedup_int8_vs_float32"],
+            "unit": "x (comm-only steps/s, int8-block vs float32 wire, "
+                    "same pacing)",
+            "workers": sweep[-1],
+        }))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)
