@@ -216,6 +216,14 @@ def main() -> None:
                         "'ratio' is 1.0 by construction, so its spread/CI "
                         "is the measured noise floor of the gate number "
                         "on this host — commit it next to the real run")
+    p.add_argument("--insight-overhead", action="store_true",
+                   help="A/B the per-round introspection layer "
+                        "(BYTEPS_ROUNDSTATS_ON, ISSUE 7) on comm-only "
+                        "small-tensor fleet rounds: off vs on (the new "
+                        "default, heartbeat summaries included). Same "
+                        "interleaved paired-ratio methodology as "
+                        "--trace-overhead. Writes --out "
+                        "(BENCH_insight_r07.json)")
     p.add_argument("--trace-overhead", action="store_true",
                    help="ISSUE 5 acceptance artifact: comm-only "
                         "small-tensor rounds over a real 2wx2s PS fleet "
@@ -236,6 +244,8 @@ def main() -> None:
         return _trace_overhead_worker(args)
     if args.trace_overhead:
         return bench_trace_overhead(args)
+    if args.insight_overhead:
+        return bench_insight_overhead(args)
     if args.sweep:
         args.mfu = True
         if args.repeats is None:
@@ -604,6 +614,7 @@ def _trace_overhead_worker(args) -> None:
         "steps_per_s": round(args.rounds / dt, 3),
         "trace_events": delta("bps_trace_events_total"),
         "trace_dropped": delta("bps_trace_dropped_total"),
+        "rounds_completed": delta("bps_rounds_completed_total"),
     }), flush=True)
     w.shutdown()
 
@@ -709,6 +720,94 @@ def bench_trace_overhead(args) -> None:
     print(json.dumps({"metric": "trace_on_overhead_pct",
                       "value": out["summary"]["trace_on_overhead_pct"],
                       "unit": "%"}))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps({"artifact": args.out}))
+
+
+def bench_insight_overhead(args) -> None:
+    """A/B the per-round introspection layer's hot-path cost (ISSUE 7
+    acceptance gate: roundstats-on — the DEFAULT — must cost <5% vs
+    off on comm-only small-tensor rounds, same methodology as
+    BENCH_trace_r06's flight-recorder gate).
+
+      off  BYTEPS_ROUNDSTATS_ON=0 — every Track site is one relaxed
+           atomic load; no heartbeat sub-payload
+      on   BYTEPS_ROUNDSTATS_ON=1 + heartbeat summaries (the default):
+           per-partition stage accumulation under one mutex, round
+           finalize gauges, and the completed-round piggyback on every
+           heartbeat
+
+    Configs interleave round-robin within each rep so both runs of one
+    rep share the host's drift conditions; overhead = the MEDIAN over
+    reps of the per-rep paired ratio off/on (drift cancels within a
+    rep). Flight recorder stays at its default (on) in BOTH configs —
+    this gate isolates the roundstats delta.
+    """
+    import os
+    import tempfile
+
+    from tools.shaped_fleet import run_fleet
+
+    repeats = args.repeats or 3
+    configs = {
+        "off": {"BYTEPS_ROUNDSTATS_ON": "0"},
+        "on": {"BYTEPS_ROUNDSTATS_ON": "1",
+               "BYTEPS_ROUNDSTATS_HEARTBEAT_SUMMARY": "1"},
+    }
+    runs = {name: [] for name in configs}
+    with tempfile.TemporaryDirectory(prefix="bps_insight_bench_") as td:
+        for rep in range(repeats):
+            for name, env in configs.items():
+                rc, recs = run_fleet(
+                    args.workers, args.servers,
+                    [os.path.abspath(__file__), "--insight-overhead",
+                     "--role", "trace_overhead_worker",
+                     "--rounds", str(args.rounds),
+                     "--warmup", str(args.warmup)],
+                    env_extra={**env, "BYTEPS_TRACE_DIR": td,
+                               "PS_HEARTBEAT_INTERVAL": "1"})
+                if rc != 0 or len(recs) != args.workers:
+                    raise SystemExit(
+                        f"{name} rep {rep} failed rc={rc} recs={len(recs)}")
+                agg = sum(r["steps_per_s"] for r in recs) / args.workers
+                runs[name].append({
+                    "steps_per_s": round(agg, 3),
+                    "rounds_completed": sum(r["rounds_completed"]
+                                            for r in recs),
+                })
+                print(json.dumps({"run": name, "rep": rep,
+                                  "steps_per_s": round(agg, 3)}))
+
+    def best(name):
+        return max(r["steps_per_s"] for r in runs[name])
+
+    ratios = sorted(off["steps_per_s"] / on["steps_per_s"]
+                    for off, on in zip(runs["off"], runs["on"]))
+    overhead_pct = round((statistics.median(ratios) - 1.0) * 100, 2)
+    out = {
+        "what": ("per-round introspection (BYTEPS_ROUNDSTATS_ON) "
+                 "hot-path overhead on comm-only ResNet-50 sub-64KB "
+                 "rounds, real 2wx2s PS fleet with 1s heartbeats "
+                 "(summaries piggybacking): off vs on (the default); "
+                 "overhead = median per-rep paired ratio over "
+                 f"{repeats} interleaved reps (drift cancels within a "
+                 "rep, the BENCH_trace_r06 methodology)"),
+        "workers": args.workers, "servers": args.servers,
+        "rounds": args.rounds, "repeats": repeats,
+        "runs": runs,
+        "summary": {
+            "steps_per_s_roundstats_off": best("off"),
+            "steps_per_s_roundstats_on": best("on"),
+            "roundstats_overhead_pct": overhead_pct,
+            "roundstats_overhead_under_5pct": overhead_pct < 5.0,
+            "rounds_summarized_on": max(
+                r["rounds_completed"] for r in runs["on"]),
+        },
+    }
+    print(json.dumps({"metric": "roundstats_overhead_pct",
+                      "value": overhead_pct, "unit": "%"}))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)

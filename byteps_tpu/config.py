@@ -113,6 +113,24 @@ class Config:
     #   to the trace dir on fatal CHECK / failure SHUTDOWN / recovery
     flight_recorder_events: int = 256     # BYTEPS_FLIGHT_RECORDER_EVENTS
 
+    # --- per-round introspection (ISSUE 7; docs/monitoring.md) -------------
+    roundstats_on: bool = True            # BYTEPS_ROUNDSTATS_ON
+    #   online per-round stage summaries on every role (queue / compress
+    #   / push wire / server_sum / wire_ack / pull / decode, wire bytes,
+    #   fused frames, retries, parked ops), accumulated into a bounded
+    #   drop-oldest ring and classified live by monitor/insight.py.
+    #   Default ON — overhead is within noise (BENCH_insight_r07.json);
+    #   0 reduces every site to one relaxed atomic load
+    roundstats_ring: int = 256            # BYTEPS_ROUNDSTATS_RING
+    #   per-rank round-record ring capacity (drop-oldest; overwrites are
+    #   reported as `dropped` in bps_round_summary)
+    roundstats_heartbeat_summary: bool = True
+    #   BYTEPS_ROUNDSTATS_HEARTBEAT_SUMMARY: piggyback completed-round
+    #   summaries on CMD_HEARTBEAT (versioned sub-payload; old/new nodes
+    #   interop) so the scheduler keeps the live fleet round table that
+    #   `python -m byteps_tpu.monitor.insight --watch` reads. 0 keeps
+    #   round summaries rank-local
+
     # --- live monitoring (byteps_tpu.monitor, docs/monitoring.md) ----------
     monitor_on: bool = False              # BYTEPS_MONITOR_ON
     monitor_port: int = 9100              # BYTEPS_MONITOR_PORT (BASE port:
@@ -304,6 +322,12 @@ class Config:
                 "BYTEPS_FLIGHT_RECORDER_EVENTS must be >= 8 (flight "
                 "recorder ring capacity; set BYTEPS_FLIGHT_RECORDER=0 "
                 "to disable the recorder instead)")
+        if self.roundstats_ring < 8:
+            raise ValueError(
+                "BYTEPS_ROUNDSTATS_RING must be >= 8 (per-rank round-"
+                "record ring capacity, drop-oldest; set "
+                "BYTEPS_ROUNDSTATS_ON=0 to disable round summaries "
+                "instead of shrinking the ring to nothing)")
         if self.num_worker < 1:
             raise ValueError("DMLC_NUM_WORKER must be >= 1")
         if self.ps_mode not in ("auto", "collective", "ps"):
@@ -467,6 +491,10 @@ def load_config() -> Config:
         flight_recorder=_env_bool("BYTEPS_FLIGHT_RECORDER", True),
         flight_recorder_events=_env_int("BYTEPS_FLIGHT_RECORDER_EVENTS",
                                         256),
+        roundstats_on=_env_bool("BYTEPS_ROUNDSTATS_ON", True),
+        roundstats_ring=_env_int("BYTEPS_ROUNDSTATS_RING", 256),
+        roundstats_heartbeat_summary=_env_bool(
+            "BYTEPS_ROUNDSTATS_HEARTBEAT_SUMMARY", True),
         monitor_on=_env_bool("BYTEPS_MONITOR_ON"),
         monitor_port=_env_int("BYTEPS_MONITOR_PORT", 9100),
         straggler_factor=float(

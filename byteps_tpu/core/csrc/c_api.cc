@@ -21,6 +21,7 @@
 #include "logging.h"
 #include "metrics.h"
 #include "postoffice.h"
+#include "roundstats.h"
 #include "server.h"
 #include "trace.h"
 #include "worker.h"
@@ -196,9 +197,15 @@ int bps_init(int role) {
   if (gl->role == ROLE_SCHEDULER) {
     Trace::Get().SetClock(0, 0);  // the scheduler IS the timebase
   }
+  // Round-summary identity (ISSUE 7): stamps the heartbeat piggyback
+  // so the scheduler's fleet table keys on real node ids.
+  RoundStats::Get().SetNode(role, id);
   Metrics::Get().Counter("bps_trace_events_total");
   Metrics::Get().Counter("bps_trace_dropped_total");
   Metrics::Get().Counter("bps_flight_dumps_total");
+  if (gl->role == ROLE_SCHEDULER) {
+    Metrics::Get().Counter("bps_round_summaries_ingested_total");
+  }
   gl->inited = true;
   return id;
 }
@@ -448,6 +455,45 @@ long long bps_metrics_snapshot(char* buf, long long maxlen) {
     buf[n] = '\0';
   }
   return need;
+}
+
+// Per-round introspection snapshot (ISSUE 7): this rank's round ring
+// (oldest -> newest), the most recent completed round, and — on a rank
+// that ingested heartbeat summaries, i.e. the scheduler — the fleet's
+// per-rank EWMA baselines and bounded round table. Same buffer contract
+// as bps_metrics_snapshot: returns the full length required; callers
+// retry with a bigger buffer when the return value >= maxlen. Served
+// live at the monitor endpoint's /rounds path and consumed by
+// python -m byteps_tpu.monitor.insight.
+long long bps_round_summary(char* buf, long long maxlen) {
+  std::string out = RoundStats::Get().SnapshotJson();
+  long long need = static_cast<long long>(out.size());
+  if (buf && maxlen > 0) {
+    long long n = need < maxlen - 1 ? need : maxlen - 1;
+    memcpy(buf, out.data(), static_cast<size_t>(n));
+    buf[n] = '\0';
+  }
+  return need;
+}
+
+// Feed one accumulation event into the round-summary layer from outside
+// the C core (stage = RoundStage). This IS the production path — the
+// ring/finalize unit tests drive wraparound and drop counters through
+// it without a topology, and a Python-side training loop can report
+// host-level stages into the same per-round records.
+void bps_round_track(int stage, int round, long long us,
+                     long long bytes) {
+  RoundStats::Get().Track(stage, round, us, bytes);
+}
+
+// Ingest a serialized heartbeat round-summary sub-payload (the exact
+// wire bytes a worker piggybacks). Returns 1 if accepted, 0 if the
+// payload was not a recognized summary — the version-interop contract
+// the tests pin down.
+int bps_round_ingest(const void* data, long long len) {
+  if (!data || len <= 0) return 0;
+  return RoundStats::Get().Ingest(data, static_cast<size_t>(len)) ? 1
+                                                                  : 0;
 }
 
 // Record into the registry from outside the C core: kind is "counter"

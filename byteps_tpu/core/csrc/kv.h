@@ -23,6 +23,7 @@
 #include "logging.h"
 #include "metrics.h"
 #include "postoffice.h"
+#include "roundstats.h"
 #include "trace.h"
 
 namespace bps {
@@ -386,6 +387,13 @@ class KVWorker {
       BPS_METRIC_COUNTER_ADD("bps_retries_total", 1);
       Trace::Get().Note("RESEND", r.head.key, r.node, r.rid,
                         r.head.version);
+      // Round attribution (ISSUE 7): resends are the retry-degraded
+      // classifier's per-round signal. Data-plane heads carry the
+      // round in version; control-plane resends (version 0 overloads)
+      // land on round 0, which the classifier reads as fleet noise.
+      if (IsDataPlaneCmd(r.head.cmd)) {
+        RoundStats::Get().Track(RS_RETRY, r.head.version);
+      }
       std::lock_guard<std::mutex> lk(mu_);
       auto it = pending_.find(r.rid);
       if (it == pending_.end()) continue;  // settled while resending

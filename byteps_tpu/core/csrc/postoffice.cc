@@ -8,6 +8,7 @@
 
 #include "logging.h"
 #include "metrics.h"
+#include "roundstats.h"
 #include "trace.h"
 
 namespace bps {
@@ -430,6 +431,14 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
         if (!departed_.count(msg.head.sender)) {
           last_heartbeat_ms_[msg.head.sender] = NowMs();
         }
+      }
+      // Piggybacked round summaries (ISSUE 7): a versioned sub-payload
+      // of the rounds the sender completed since its last beat. Ingest
+      // validates magic/version/length and silently ignores anything
+      // else, so old senders (empty payload) and future generations
+      // interop; the heartbeat itself needed only the header above.
+      if (role_ == ROLE_SCHEDULER && !msg.payload.empty()) {
+        RoundStats::Get().Ingest(msg.payload.data(), msg.payload.size());
       }
       // Echo for clock alignment (ISSUE 5): arg0 = the sender's send
       // timestamp, arg1 = this (scheduler) clock now. The sender keeps
@@ -875,7 +884,14 @@ void Postoffice::HeartbeatLoop() {
       if (it == node_fd_.end()) break;
       fd = it->second;
     }
-    if (!van_->Send(fd, h)) {
+    // Piggyback the rounds completed since the last beat (ISSUE 7) as
+    // a versioned sub-payload. Heartbeats are control-plane — never
+    // chaos-injected, never retried — so summaries ride a channel the
+    // fault harness provably leaves alone (the PR 3 contract).
+    std::string rs_payload;
+    RoundStats::Get().FillWire(&rs_payload);
+    if (!van_->Send(fd, h, rs_payload.data(),
+                    static_cast<int64_t>(rs_payload.size()))) {
       // The scheduler connection is gone. For a server this is the ONLY
       // exit signal once Finalize's indefinite wait has begun (the
       // SHUTDOWN broadcast can never arrive over a dead connection), and

@@ -7,6 +7,7 @@
 #include "cpu_reducer.h"
 #include "logging.h"
 #include "metrics.h"
+#include "roundstats.h"
 #include "trace.h"
 #include "worker.h"  // NowUs
 
@@ -58,6 +59,15 @@ void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
   Metrics::Get().Counter("bps_quant_bytes_saved_total");
   Metrics::Get().Histogram("bps_server_sum_us");
   Metrics::Get().Histogram("bps_fusion_batch_keys");
+  // Per-round introspection series (ISSUE 7), server view: sum time,
+  // parked ops, and recv bytes per round — published at round finalize
+  // by RoundStats, present-from-zero here like every other series.
+  Metrics::Get().Counter("bps_rounds_completed_total");
+  for (const char* g :
+       {"bps_round_last", "bps_round_sum_us", "bps_round_wire_bytes",
+        "bps_round_parked"}) {
+    Metrics::Get().Gauge(g);
+  }
   queues_.clear();
   for (int i = 0; i < engine_threads; ++i) {
     queues_.push_back(std::make_unique<EngineQueue>());
@@ -537,6 +547,7 @@ void BytePSServer::Process(EngineTask&& task) {
           }
           Trace::Get().Instant("s_park", h.key, h.sender, h.req_id,
                                h.version);
+          RoundStats::Get().Track(RS_PARK, h.version);
           ks->parked_pushes[slot].push_back(std::move(task));
           break;
         }
@@ -546,6 +557,10 @@ void BytePSServer::Process(EngineTask&& task) {
       // worker's push span to this server's work in the merged view.
       const int64_t t_trace =
           Trace::Get().MainOn() ? NowUs() : 0;
+      // Round-summary clock (ISSUE 7): the whole decode+assign/sum for
+      // this push; reported back on the ack's arg0 so the SENDER can
+      // split its push wall into server_sum vs wire_ack per round.
+      const int64_t t_rs = RoundStats::Get().On() ? NowUs() : 0;
       const char* data = msg.payload.data();
       int64_t data_len = static_cast<int64_t>(msg.payload.size());
       // Decompress (compressed pushes are always float32 streams).
@@ -647,11 +662,23 @@ void BytePSServer::Process(EngineTask&& task) {
         Trace::Get().Flow(TRACE_FLOW_STEP, "req", h.key, t_trace,
                           TraceFlowId(h.sender, h.req_id));
       }
+      const int64_t sum_us = t_rs ? NowUs() - t_rs : 0;
+      if (t_rs) {
+        // Server's own per-round table: sum time + encoded recv bytes.
+        RoundStats::Get().Track(
+            RS_SUM, h.version, sum_us,
+            static_cast<int64_t>(msg.payload.size()));
+      }
       MsgHeader ack{};
       ack.cmd = CMD_PUSH_ACK;
       ack.sender = po_->my_id();
       ack.key = h.key;
       ack.req_id = h.req_id;
+      // arg0 was never used on push acks: carry the server's
+      // decode+sum time so the worker's round summary can attribute
+      // server_sum vs wire_ack online. Old workers ignore it; old
+      // servers send 0, which reads as "all wire" (degrades honestly).
+      ack.arg0 = sum_us;
       if (is_async) ack.arg1 = ks->async_pushes;
       // A replayed parked sub-push already acked at park time
       // (ack-on-park above); parking never happens in async mode, so
@@ -694,6 +721,7 @@ void BytePSServer::Process(EngineTask&& task) {
         } else {
           Trace::Get().Instant("s_park", h.key, h.sender, h.req_id,
                                h.version);
+          RoundStats::Get().Track(RS_PARK, h.version);
           ks->pending_pulls[slot].push_back(std::move(task));
         }
       }

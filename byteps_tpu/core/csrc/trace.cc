@@ -68,6 +68,22 @@ void Trace::SetNode(int role, int node_id, int worker_rank) {
   role_.store(role, std::memory_order_relaxed);
   node_id_.store(node_id, std::memory_order_relaxed);
   worker_rank_.store(worker_rank, std::memory_order_relaxed);
+  if (node_id < 0) return;
+  // A flight dump written before the topology completed carries a pid
+  // name nobody can attribute; now that this rank knows who it is,
+  // give the file its canonical role/node name (best-effort — the
+  // dump content, with its meta, is the source of truth either way).
+  std::string old_path;
+  {
+    std::lock_guard<std::mutex> lk(reason_mu_);
+    old_path.swap(pid_dump_path_);
+  }
+  if (old_path.empty()) return;
+  std::string dir = old_path.substr(0, old_path.find_last_of('/'));
+  char new_path[512];
+  snprintf(new_path, sizeof(new_path), "%s/flight_r%d_n%d.json",
+           dir.c_str(), role, node_id);
+  ::rename(old_path.c_str(), new_path);
 }
 
 void Trace::SetClock(int64_t offset_us, int64_t rtt_us) {
@@ -105,7 +121,8 @@ void Trace::Emit(const TraceRec& r, bool significant) {
 }
 
 void Trace::Span(const char* name, int64_t key, int64_t start_us,
-                 int64_t end_us, int peer, int32_t req_id, int32_t round) {
+                 int64_t end_us, int peer, int32_t req_id, int32_t round,
+                 int64_t wire_bytes, int64_t raw_bytes) {
   if (!MainOn()) return;
   TraceRec r;
   snprintf(r.name, sizeof(r.name), "%s", name);
@@ -116,6 +133,8 @@ void Trace::Span(const char* name, int64_t key, int64_t start_us,
   r.peer = peer;
   r.req_id = req_id;
   r.round = round;
+  r.wire_bytes = wire_bytes;
+  r.raw_bytes = raw_bytes;
   Emit(r, false);
 }
 
@@ -189,15 +208,24 @@ long long Trace::DumpRing(TraceRing* ring, const char* path, bool drain,
     const TraceRec& e = evs[i];
     const char* sep = i + 1 < evs.size() ? "," : "";
     if (e.phase == TRACE_SPAN) {
+      // Byte labels only when present: unlabelled spans keep the
+      // pre-ISSUE-7 args shape byte for byte.
+      char bytes_args[96] = "";
+      if (e.raw_bytes > 0) {
+        snprintf(bytes_args, sizeof(bytes_args),
+                 ",\"wire_bytes\":%lld,\"raw_bytes\":%lld",
+                 static_cast<long long>(e.wire_bytes),
+                 static_cast<long long>(e.raw_bytes));
+      }
       fprintf(f,
               "{\"name\":\"%s\",\"ph\":\"X\",\"pid\":%d,\"tid\":%lld,"
               "\"ts\":%lld,\"dur\":%lld,\"args\":{\"key\":%lld,"
-              "\"peer\":%d,\"req\":%d,\"round\":%d}}%s\n",
+              "\"peer\":%d,\"req\":%d,\"round\":%d%s}}%s\n",
               e.name, pid_field, static_cast<long long>(e.key),
               static_cast<long long>(e.ts_us),
               static_cast<long long>(e.dur_us),
               static_cast<long long>(e.key), e.peer, e.req_id, e.round,
-              sep);
+              bytes_args, sep);
     } else if (e.phase == TRACE_FLOW_OUT || e.phase == TRACE_FLOW_STEP ||
                e.phase == TRACE_FLOW_IN) {
       // Chrome flow-event triple: bound by (cat, name, id); "f" carries
@@ -257,9 +285,13 @@ long long Trace::FlightDumpAuto(const char* reason) {
              role_.load(std::memory_order_relaxed), nid);
   } else {
     // Pre-topology fatal: no node id yet; the pid keeps files distinct.
+    // Remember the path — SetNode renames it to the role/node form if
+    // this process survives long enough to learn its identity.
     snprintf(path, sizeof(path), "%s/flight_r%d_pid%d.json", dir,
              role_.load(std::memory_order_relaxed),
              static_cast<int>(getpid()));
+    std::lock_guard<std::mutex> lk(reason_mu_);
+    pid_dump_path_ = path;
   }
   long long n = DumpFlight(path);
   if (n >= 0) BPS_METRIC_COUNTER_ADD("bps_flight_dumps_total", 1);

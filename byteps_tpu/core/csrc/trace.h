@@ -60,6 +60,12 @@ struct TraceRec {
   int32_t req_id = -1;
   int32_t round = -1;   // head.version where known
   int32_t aux = 0;      // cmd for wire instants; free-form otherwise
+  // Byte labels for data-carrying spans (ISSUE 7 satellite): what
+  // actually crossed the wire vs the decoded length — the quantized
+  // wire's push/qdecode spans dump these so the timeline report can
+  // show per-span quantized-vs-raw freight. 0/0 = unlabelled.
+  int64_t wire_bytes = 0;
+  int64_t raw_bytes = 0;
 };
 
 // Flow id for the (sender, req_id) pair: req ids are monotone per
@@ -153,7 +159,8 @@ class Trace {
 
   // Main-ring emitters (no-ops unless MainOn()).
   void Span(const char* name, int64_t key, int64_t start_us, int64_t end_us,
-            int peer = -1, int32_t req_id = -1, int32_t round = -1);
+            int peer = -1, int32_t req_id = -1, int32_t round = -1,
+            int64_t wire_bytes = 0, int64_t raw_bytes = 0);
   void Instant(const char* name, int64_t key, int peer = -1,
                int32_t req_id = -1, int32_t aux = 0, int32_t round = -1);
   void Flow(TracePhase ph, const char* name, int64_t key, int64_t ts_us,
@@ -199,6 +206,13 @@ class Trace {
   std::atomic<int64_t> clock_offset_us_{0};
   std::atomic<int64_t> clock_rtt_us_{-1};
   std::string last_reason_;  // guarded by reason_mu_
+  // Pre-topology auto-dump path (flight_r<role>_pid<pid>.json): a dump
+  // written before this rank learned its node id is unattributable to
+  // humans and to timeline.py's role/node globs. SetNode renames it to
+  // the canonical flight_r<role>_n<id>.json once topology is known
+  // (ISSUE 7 satellite); a process that dies pre-topology keeps the
+  // pid name — the merge tool tolerates both. Guarded by reason_mu_.
+  std::string pid_dump_path_;
   std::mutex reason_mu_;
 };
 

@@ -9,6 +9,7 @@
 #include "cpu_reducer.h"
 #include "logging.h"
 #include "metrics.h"
+#include "roundstats.h"
 
 namespace bps {
 
@@ -94,6 +95,20 @@ void BytePSWorker::Start(Postoffice* po, KVWorker* kv, int64_t partition_bytes,
   Metrics::Get().Counter("bps_recoveries_total");
   Metrics::Get().Gauge("bps_membership_epoch");
   Metrics::Get().Gauge("bps_recovering");
+  // Per-round introspection series (ISSUE 7): present-from-zero so
+  // monitor.top's BOTTLENECK column reads zeros, not holes, on an idle
+  // worker. The gauges hold the LAST completed round's stage breakdown
+  // (published by RoundStats at round finalize).
+  Metrics::Get().Counter("bps_rounds_completed_total");
+  for (const char* g :
+       {"bps_round_last", "bps_round_parts", "bps_round_queue_us",
+        "bps_round_comp_us", "bps_round_push_us", "bps_round_sum_us",
+        "bps_round_wire_ack_us", "bps_round_pull_us", "bps_round_dec_us",
+        "bps_round_wire_bytes", "bps_round_wire_msgs",
+        "bps_round_retries", "bps_round_parked"}) {
+    Metrics::Get().Gauge(g);
+  }
+  Metrics::Get().Histogram("bps_round_wall_us");
   recovery_on_ = RecoveryEnabled();
   // Reference semantics: BYTEPS_SCHEDULING_CREDIT is an in-flight BYTE
   // budget. 0 = auto: four full partitions' worth. A value under 1024
@@ -416,9 +431,11 @@ void BytePSWorker::FlushBatch(int server_id, std::vector<PushOp> ops) {
 }
 
 void BytePSWorker::Record(int64_t key, const char* stage, int64_t start_us,
-                          int peer, int32_t req_id, int32_t round) {
+                          int peer, int32_t req_id, int32_t round,
+                          int64_t wire_bytes, int64_t raw_bytes) {
   if (!trace_on_) return;
-  Trace::Get().Span(stage, key, start_us, NowUs(), peer, req_id, round);
+  Trace::Get().Span(stage, key, start_us, NowUs(), peer, req_id, round,
+                    wire_bytes, raw_bytes);
 }
 
 int64_t BytePSWorker::Declare(const std::string& name, int64_t nelem,
@@ -534,7 +551,12 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
     // sub-partition-size tensors coalesce; full partitions keep their
     // own frames.
     task.fusible = fusion_bytes_ > 0 && task.bytes < fusion_bytes_;
-    task.run = [this, ctx, p, ptr, esz, version, scale, async_mode, handle] {
+    const int64_t t_enq = NowUs();
+    task.run = [this, ctx, p, ptr, esz, version, scale, async_mode, handle,
+                t_enq] {
+      // Scheduled-queue wait (credit admission + priority) — the first
+      // stage of the per-round breakdown (ISSUE 7).
+      RoundStats::Get().Track(RS_QUEUE, version, NowUs() - t_enq);
       char* base = static_cast<char*>(ptr) + p->offset * esz;
       int64_t raw_len = p->len * esz;
       PushOp op;
@@ -556,6 +578,7 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
         op.payload_len = static_cast<int64_t>(p->comp_buf.size());
         op.flags |= FLAG_COMPRESSED;
         Record(p->key, "compress", t0);
+        RoundStats::Get().Track(RS_COMP, version, NowUs() - t0);
         BPS_METRIC_HISTO_OBSERVE("bps_compress_us", NowUs() - t0);
         BPS_METRIC_COUNTER_ADD("bps_compress_in_bytes_total", raw_len);
         BPS_METRIC_COUNTER_ADD("bps_compress_out_bytes_total",
@@ -579,7 +602,11 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
         op.payload = p->qbuf.data();
         op.payload_len = static_cast<int64_t>(p->qbuf.size());
         op.flags |= FLAG_WIRE_QUANT;
-        Record(p->key, "compress", t0);
+        // Distinct span (ISSUE 7 satellite): quant encode time was
+        // invisible under the shared "compress" label — the critical-
+        // path report now attributes it as its own stage.
+        Record(p->key, "qencode", t0);
+        RoundStats::Get().Track(RS_COMP, version, NowUs() - t0);
         BPS_METRIC_COUNTER_ADD("bps_quant_bytes_on_wire_total",
                                op.payload_len);
         BPS_METRIC_COUNTER_ADD("bps_quant_bytes_saved_total",
@@ -601,6 +628,7 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
       Trace::Get().Instant("enqueue", p->key, p->server_id, -1, 0,
                            version);
     }
+    RoundStats::Get().Track(RS_ENQ, version);
     queue_->Push(std::move(task));
   }
   return handle_id;
@@ -629,15 +657,18 @@ void BytePSWorker::SendPush(PushOp op) {
   // and server-side recv totals sum to the same number fleet-wide.
   BPS_METRIC_COUNTER_ADD("bps_push_bytes_total", op.payload_len);
   BPS_METRIC_COUNTER_ADD("bps_push_partitions_total", 1);
+  RoundStats::Get().Track(RS_FRAME, version);
+  const int64_t plen = op.payload_len;
   RecTrackPush(p, op);
   int push_rid = kv_->Request(
       p->server_id, h, op.payload, op.payload_len,
       [this, ctx, p, base, raw_len, version, scale, flags, handle,
-       t_push](Message&& ack) {
+       t_push, plen](Message&& ack) {
         if (ack.head.cmd == CMD_ERROR) {
           // Dead server: fail the handle now with the diagnostic
           // instead of blocking Wait until the heartbeat detector.
           RecClear(p);
+          RoundStats::Get().Track(RS_DONE, version);
           FailHandle(handle, p->key, std::move(ack));
           queue_->ReleaseCredit(raw_len);
           return;
@@ -653,8 +684,15 @@ void BytePSWorker::SendPush(PushOp op) {
                             TraceFlowId(po_->my_id(), ack.head.req_id));
         }
         Record(p->key, "push", t_push, p->server_id, ack.head.req_id,
-               version);
+               version, plen, raw_len);
         BPS_METRIC_HISTO_OBSERVE("bps_push_us", NowUs() - t_push);
+        // Per-round breakdown: push wall, and the server's own
+        // decode+sum time reported back on the ack (arg0 — a field
+        // CMD_PUSH_ACK never used; old servers leave it 0, which
+        // degrades gracefully to "all wire"). wire_ack = push - sum.
+        RoundStats::Get().Track(RS_PUSH, version, NowUs() - t_push,
+                                plen);
+        RoundStats::Get().Track(RS_SUM, version, ack.head.arg0);
         RecTrackAck(p);
         // Async: the ack carries the server's fleet-wide apply count
         // for this key as of OUR push; the pull resp carries it as
@@ -672,12 +710,14 @@ void BytePSWorker::SendPush(PushOp op) {
         // async param) is still handled below.
         ph.flags = flags & (FLAG_ASYNC | FLAG_WIRE_QUANT);
         int64_t t_pull = NowUs();
+        RoundStats::Get().Track(RS_FRAME, version);
         int pull_rid = kv_->Request(
             p->server_id, ph, nullptr, 0,
             [this, ctx, p, base, raw_len, version, scale, handle,
              t_pull, flags, at_push](Message&& resp) {
               if (resp.head.cmd == CMD_ERROR) {
                 RecClear(p);
+                RoundStats::Get().Track(RS_DONE, version);
                 FailHandle(handle, p->key, std::move(resp));
                 queue_->ReleaseCredit(raw_len);
                 return;
@@ -693,6 +733,9 @@ void BytePSWorker::SendPush(PushOp op) {
               Record(p->key, "pull", t_pull, p->server_id,
                      resp.head.req_id, version);
               BPS_METRIC_HISTO_OBSERVE("bps_pull_us", NowUs() - t_pull);
+              RoundStats::Get().Track(
+                  RS_PULL, version, NowUs() - t_pull,
+                  static_cast<int64_t>(resp.payload.size()));
               BPS_METRIC_COUNTER_ADD(
                   "bps_pull_bytes_total",
                   static_cast<int64_t>(resp.payload.size()));
@@ -726,17 +769,28 @@ void BytePSWorker::SendPush(PushOp op) {
                     reinterpret_cast<float*>(base), p->len);
                 BPS_METRIC_HISTO_OBSERVE("bps_decompress_us",
                                          NowUs() - t_dec);
+                RoundStats::Get().Track(RS_DEC, version,
+                                        NowUs() - t_dec);
               } else if (resp.head.flags & FLAG_WIRE_QUANT) {
                 // Quantized reply: dequantize the aggregate straight
                 // into the caller's buffer.
                 BPS_CHECK_EQ(resp.head.arg0, raw_len)
                     << "quant pull length mismatch for key " << p->key;
+                int64_t t_dec = NowUs();
                 BPS_CHECK(BlockQuant::Decode(
                     resp.payload.data(),
                     static_cast<int64_t>(resp.payload.size()),
                     reinterpret_cast<float*>(base), p->len))
                     << "malformed quantized pull reply for key "
                     << p->key;
+                // qdecode span (ISSUE 7 satellite): the reply-leg
+                // dequant was invisible in critical paths before.
+                Record(p->key, "qdecode", t_dec, p->server_id,
+                       resp.head.req_id, version,
+                       static_cast<int64_t>(resp.payload.size()),
+                       raw_len);
+                RoundStats::Get().Track(RS_DEC, version,
+                                        NowUs() - t_dec);
                 BPS_METRIC_COUNTER_ADD(
                     "bps_quant_bytes_on_wire_total",
                     static_cast<int64_t>(resp.payload.size()));
@@ -752,6 +806,7 @@ void BytePSWorker::SendPush(PushOp op) {
               // Before Scale: the retained re-seed payload must be the
               // server's slot bytes (the unscaled sum).
               RecTrackDone(p, version, base, raw_len);
+              RoundStats::Get().Track(RS_DONE, version);
               if (scale != 1.0) {
                 CpuReducer::Scale(base, scale, raw_len, ctx->dtype);
               }
@@ -845,6 +900,10 @@ void BytePSWorker::SendFusedPush(int server_id, std::vector<PushOp> ops) {
   BPS_METRIC_COUNTER_ADD("bps_push_partitions_total", n);
   BPS_METRIC_COUNTER_ADD("bps_fused_msgs_total", 1);
   BPS_METRIC_HISTO_OBSERVE("bps_fusion_batch_keys", n);
+  // One wire frame for the whole batch, charged to the lead sub-op's
+  // round (frames may legally mix rounds across the duplicate-key
+  // flush; the lead round is where the frame-count signal belongs).
+  RoundStats::Get().Track(RS_FRAME, table[0].version, 0, /*fused=*/1);
   int64_t t_push = NowUs();
   if (recovery_on_) {
     std::lock_guard<std::mutex> lk(rec_mu_);
@@ -914,8 +973,14 @@ void BytePSWorker::OnFusedAck(
       fprintf(stderr, "[QDEBUG] push_ack key=%lld\n",
               (long long)op.p->key);
     Record(op.p->key, "push", t_push, server_id, ack.head.req_id,
-           op.version);
+           op.version, op.payload_len, op.raw_len);
     BPS_METRIC_HISTO_OBSERVE("bps_push_us", NowUs() - t_push);
+    // Per-round breakdown per sub-op: the batched ack carries each
+    // sub-push's server decode+sum time in its sub-header arg0 (the
+    // same contract as the single-frame ack).
+    RoundStats::Get().Track(RS_PUSH, op.version, NowUs() - t_push,
+                            op.payload_len);
+    RoundStats::Get().Track(RS_SUM, op.version, subs[i].arg0);
     (*at_push)[i] = subs[i].arg1;  // async apply count as of our push
     SubHeader& s = table[i];
     s.key = op.p->key;
@@ -938,6 +1003,7 @@ void BytePSWorker::OnFusedAck(
   h.arg0 = n;
   iovec seg{table.data(), static_cast<size_t>(n) * sizeof(SubHeader)};
   int64_t t_pull = NowUs();
+  RoundStats::Get().Track(RS_FRAME, table[0].version, 0, /*fused=*/1);
   int pull_rid = kv_->RequestV(
       server_id, h, &seg, 1,
       [this, batch, at_push, t_pull](Message&& resp) {
@@ -983,6 +1049,8 @@ void BytePSWorker::OnFusedPullResp(
     Record(op.p->key, "pull", t_pull, op.p->server_id,
            resp.head.req_id, op.version);
     BPS_METRIC_HISTO_OBSERVE("bps_pull_us", NowUs() - t_pull);
+    RoundStats::Get().Track(RS_PULL, op.version, NowUs() - t_pull,
+                            s.len);
     BPS_METRIC_COUNTER_ADD("bps_pull_bytes_total", s.len);
     if (op.flags & FLAG_ASYNC) {
       int64_t stale = s.arg1 - (*at_push)[i];
@@ -1008,13 +1076,18 @@ void BytePSWorker::OnFusedPullResp(
       op.p->comp->Decompress(data, s.len,
                              reinterpret_cast<float*>(op.base), op.p->len);
       BPS_METRIC_HISTO_OBSERVE("bps_decompress_us", NowUs() - t_dec);
+      RoundStats::Get().Track(RS_DEC, op.version, NowUs() - t_dec);
     } else if (s.flags & FLAG_WIRE_QUANT) {
       BPS_CHECK_EQ(s.arg0, op.raw_len)
           << "quant pull length mismatch for key " << op.p->key;
+      int64_t t_dec = NowUs();
       BPS_CHECK(BlockQuant::Decode(data, s.len,
                                    reinterpret_cast<float*>(op.base),
                                    op.p->len))
           << "malformed quantized pull reply for key " << op.p->key;
+      Record(op.p->key, "qdecode", t_dec, op.p->server_id,
+             resp.head.req_id, op.version, s.len, op.raw_len);
+      RoundStats::Get().Track(RS_DEC, op.version, NowUs() - t_dec);
       BPS_METRIC_COUNTER_ADD("bps_quant_bytes_on_wire_total", s.len);
       BPS_METRIC_COUNTER_ADD("bps_quant_bytes_saved_total",
                              op.raw_len - s.len);
@@ -1024,6 +1097,7 @@ void BytePSWorker::OnFusedPullResp(
       memcpy(op.base, data, static_cast<size_t>(op.raw_len));
     }
     RecTrackDone(op.p, op.version, op.base, op.raw_len);
+    RoundStats::Get().Track(RS_DONE, op.version);
     if (op.scale != 1.0) {
       CpuReducer::Scale(op.base, op.scale, op.raw_len, op.ctx->dtype);
     }
@@ -1042,6 +1116,7 @@ void BytePSWorker::FailBatch(
     e.head = err.head;
     e.payload.assign(err.payload.begin(), err.payload.end());
     RecClear(op.p);
+    RoundStats::Get().Track(RS_DONE, op.version);
     FailHandle(op.handle, op.p->key, std::move(e));
     queue_->ReleaseCredit(op.raw_len);
   }

@@ -190,8 +190,12 @@ class BytePSWorker {
   }
   // Span into the shared main trace ring (trace.h); `round`/`peer`/`req`
   // feed the merge tool's stage attribution and flow stitching.
+  // `wire_bytes`/`raw_bytes` label data-carrying spans (push/qdecode)
+  // with their on-wire vs decoded sizes, so the timeline report can
+  // show quantized-vs-raw freight per span (ISSUE 7 satellite).
   void Record(int64_t key, const char* stage, int64_t start_us,
-              int peer = -1, int32_t req_id = -1, int32_t round = -1);
+              int peer = -1, int32_t req_id = -1, int32_t round = -1,
+              int64_t wire_bytes = 0, int64_t raw_bytes = 0);
   // Mark a handle failed with the CMD_ERROR diagnostic and complete it.
   void FailHandle(const std::shared_ptr<Handle>& handle, int64_t key,
                   Message&& err);

@@ -101,6 +101,15 @@ def _load() -> ctypes.CDLL:
     # below are now views over it.
     lib.bps_metrics_snapshot.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
     lib.bps_metrics_snapshot.restype = ctypes.c_longlong
+    # Per-round introspection (ISSUE 7): summary snapshot + the raw
+    # accumulation/ingest hooks (test harness + Python-side reporters).
+    lib.bps_round_summary.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+    lib.bps_round_summary.restype = ctypes.c_longlong
+    lib.bps_round_track.argtypes = [ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_longlong, ctypes.c_longlong]
+    lib.bps_round_track.restype = None
+    lib.bps_round_ingest.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+    lib.bps_round_ingest.restype = ctypes.c_int
     lib.bps_metrics_observe.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                         ctypes.c_longlong]
     lib.bps_metrics_observe.restype = ctypes.c_int
@@ -125,6 +134,44 @@ def metrics_snapshot() -> dict:
         if need < size:
             return json.loads(buf.value.decode())
         size = need + 1
+
+
+def round_summary() -> dict:
+    """Parse the C core's per-round introspection snapshot (ISSUE 7):
+    this rank's round ring plus, on the scheduler, the fleet's per-rank
+    EWMA baselines and round table ingested from heartbeat summaries.
+    Works in any process state (an idle rank reports an empty ring)."""
+    import json
+
+    lib = _load()
+    size = 1 << 16
+    while True:
+        buf = ctypes.create_string_buffer(size)
+        need = int(lib.bps_round_summary(buf, size))
+        if need < size:
+            return json.loads(buf.value.decode())
+        size = need + 1
+
+
+# RoundStage values (mirror csrc/roundstats.h).
+ROUND_STAGES = {
+    "enq": 0, "queue": 1, "comp": 2, "push": 3, "sum": 4, "pull": 5,
+    "dec": 6, "retry": 7, "park": 8, "frame": 9, "done": 10,
+}
+
+
+def round_track(stage: str, round_no: int, us: int = 0,
+                nbytes: int = 0) -> None:
+    """Feed one accumulation event into the round-summary ring (the
+    production Track path — used by tests and Python-side reporters)."""
+    _load().bps_round_track(ROUND_STAGES[stage], int(round_no), int(us),
+                            int(nbytes))
+
+
+def round_ingest(payload: bytes) -> bool:
+    """Ingest serialized heartbeat round-summary wire bytes; False when
+    the payload is not a recognized summary (version interop)."""
+    return bool(_load().bps_round_ingest(payload, len(payload)))
 
 
 def metrics_observe(kind: str, name: str, value: int) -> None:
@@ -225,6 +272,13 @@ def _apply_config_env(cfg: Optional[Config]) -> None:
         cfg.flight_recorder_events)
     os.environ["BYTEPS_MONITOR_ON"] = "1" if cfg.monitor_on else "0"
     os.environ["BYTEPS_MONITOR_PORT"] = str(cfg.monitor_port)
+    # Per-round introspection (ISSUE 7): every role reads these — the
+    # workers/servers to accumulate and piggyback, the scheduler to
+    # size nothing but still answer bps_round_summary consistently.
+    os.environ["BYTEPS_ROUNDSTATS_ON"] = "1" if cfg.roundstats_on else "0"
+    os.environ["BYTEPS_ROUNDSTATS_RING"] = str(cfg.roundstats_ring)
+    os.environ["BYTEPS_ROUNDSTATS_HEARTBEAT_SUMMARY"] = (
+        "1" if cfg.roundstats_heartbeat_summary else "0")
     # Transient-fault tolerance + chaos harness (the C core reads these
     # at init; docs/env.md "Fault tolerance and chaos injection").
     os.environ["BYTEPS_RETRY_MAX"] = str(cfg.retry_max)

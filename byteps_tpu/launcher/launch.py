@@ -299,6 +299,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "bytes each way (docs/performance.md 'Quantized "
                         "wire'); tune with BYTEPS_WIRE_QUANT_BLOCK / "
                         "BYTEPS_WIRE_QUANT_MIN_BYTES")
+    p.add_argument("--no-roundstats", action="store_true",
+                   help="disable the default-on per-round introspection "
+                        "layer (BYTEPS_ROUNDSTATS_ON=0): no per-round "
+                        "stage summaries, no heartbeat-piggybacked fleet "
+                        "round table, no live bottleneck attribution "
+                        "(`python -m byteps_tpu.monitor.insight`); each "
+                        "instrumentation site reduces to one relaxed "
+                        "atomic load (docs/monitoring.md 'Round insight')")
     p.add_argument("--trace-dir", metavar="DIR", default="",
                    help="arm fleet-wide distributed tracing "
                         "(BYTEPS_TRACE_ON=1, BYTEPS_TRACE_DIR=DIR): "
@@ -356,6 +364,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         os.environ["BYTEPS_FUSION_BYTES"] = str(args.fusion_bytes)
     if args.wire_quant:
         os.environ["BYTEPS_WIRE_QUANT"] = "1"
+    if args.no_roundstats:
+        os.environ["BYTEPS_ROUNDSTATS_ON"] = "0"
     if args.chaos:
         chaos_envs = {"drop": "BYTEPS_CHAOS_DROP",
                       "dup": "BYTEPS_CHAOS_DUP",

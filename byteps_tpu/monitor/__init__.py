@@ -17,6 +17,11 @@ compression, and to spot sick nodes before they stall the fleet:
 - ``top``      — ``python -m byteps_tpu.monitor.top``: scrape every role
   endpoint, compute per-worker push-latency skew, flag stragglers and
   dead/stale heartbeats.
+- ``insight``  — ``python -m byteps_tpu.monitor.insight``: live
+  per-round bottleneck attribution from the scheduler's fleet round
+  table (``/rounds``): names the dominant stage, classifies the fleet
+  state (wire-bound / sum-bound / straggler-skewed / retry-degraded /
+  healthy), flags EWMA regressions, and emits advisory tuning hints.
 
 See docs/monitoring.md for the endpoint layout, metric catalog, and
 straggler thresholds.

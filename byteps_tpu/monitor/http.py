@@ -31,6 +31,16 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 body = _metrics.prometheus_text().encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
                 code = 200
+            elif self.path.split("?")[0] == "/rounds":
+                # Per-round introspection snapshot (ISSUE 7): this
+                # rank's round ring; on the scheduler, also the fleet
+                # round table + per-rank EWMA baselines ingested from
+                # heartbeat summaries. `python -m
+                # byteps_tpu.monitor.insight --watch` polls this.
+                from byteps_tpu.core.ffi import round_summary
+                body = json.dumps(round_summary()).encode()
+                ctype = "application/json"
+                code = 200
             elif self.path.split("?")[0] == "/healthz":
                 snap = _metrics.snapshot()
                 dead = snap.get("dead_nodes", [])

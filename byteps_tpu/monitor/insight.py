@@ -1,0 +1,390 @@
+"""Online per-round bottleneck attribution + fleet-state classification
+(ISSUE 7).
+
+The C core keeps a per-round summary ring on every rank (csrc/
+roundstats.h): per-stage wall time, wire bytes/frames, retries, parked
+ops. Workers piggyback completed rounds on their heartbeats; the
+scheduler folds them into per-rank EWMA baselines and a fleet round
+table, served raw at the monitor endpoint's ``/rounds`` path
+(``bps_round_summary``). This module is the judgment layer on top:
+
+- ``dominant_stage``   — which stage bound a round record;
+- ``classify``         — the fleet state: ``wire-bound`` /
+  ``sum-bound`` / ``straggler-skewed`` / ``retry-degraded`` /
+  ``healthy``;
+- ``regressions``      — ranks whose latest round wall blew past their
+  EWMA baseline;
+- ``hints``            — *advisory* tuning hints naming the knob (e.g.
+  "wire msgs dominate -> raise BYTEPS_FUSION_BYTES"). Hints only, no
+  actuation: this PR is the sensor; the closed-loop controller
+  (ROADMAP item 3) consumes the same classification as its input.
+
+``python -m byteps_tpu.monitor.insight --watch`` scrapes the
+scheduler's ``/rounds`` endpoint and prints a live scrolling per-round
+report; ``monitor.top`` reuses ``classify``/``dominant_stage`` for its
+BOTTLENECK column and fleet-state header.
+
+Stage taxonomy (docs/monitoring.md "Round insight"): ``queue``
+(scheduled-queue wait), ``compress`` (codec + qencode), ``wire_ack``
+(push wall minus the server's ack-reported sum time: wire transit,
+server queueing, ack return), ``server_sum`` (decode+sum on the
+server), ``pull_wait`` (pull issue -> response; includes waiting for
+PEERS' pushes — the straggler signal), ``decode`` (decompress +
+qdecode).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+import urllib.request
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Attribution stages, in report order. Keys into a breakdown dict.
+STAGES = ("queue", "compress", "wire_ack", "server_sum", "pull_wait",
+          "decode")
+
+# Stages the fleet-state dominance rule considers: the ACTIVE stages —
+# time something was being computed or carried. The two WAIT stages are
+# deliberately excluded from dominance:
+#  - pull_wait is mostly the echo of PEERS' bottlenecks (a pull waits
+#    for every other rank's push to land), so in a symmetric
+#    wire-bound fleet it mirrors wire_ack and would split the dominant
+#    share in half; skew in it is caught by the straggler rule;
+#  - queue wait is the echo of DOWNSTREAM serialization, quadratically:
+#    with a backlog of N tasks the k-th waits k x the per-task send
+#    time, so the queue total is ~N/2 x the wire total for ANY
+#    wire-gated round — dominance over it would classify every
+#    backlogged round "queue-bound" regardless of what actually gates
+#    the drain rate.
+# Both stay in the per-rank breakdown, the BOTTLENECK column, and the
+# hints (where "mostly waiting" is exactly the informative reading).
+ATTRIB_STAGES = ("compress", "wire_ack", "server_sum", "decode")
+
+# A stage must own at least this share of the round wall before the
+# fleet is declared BOUND on it; below, no single stage gates the round
+# and the state is healthy.
+DOMINANCE_SHARE = 0.4
+
+# Straggler rule: same shape as monitor.top's — a rank whose mean
+# per-partition push wall exceeds factor x the fleet low-median, above
+# an absolute floor that keeps loopback microsecond noise quiet.
+PUSH_FLOOR_US = 1000.0
+
+# Regression rule: latest round wall vs the rank's EWMA baseline, only
+# once the baseline has seen enough rounds to mean something.
+REGRESS_FACTOR = 1.5
+REGRESS_MIN_UPDATES = 3
+
+FLEET_STATES = ("healthy", "wire-bound", "sum-bound", "straggler-skewed",
+                "retry-degraded")
+
+
+def stage_breakdown(rec: dict) -> Dict[str, float]:
+    """Per-stage microseconds from one round record (the JSON shape
+    ``bps_round_summary`` emits). ``wire_ack`` is derived when absent:
+    push wall minus the server-reported sum time."""
+    push = float(rec.get("push_us", 0))
+    sum_us = float(rec.get("sum_us", 0))
+    wire_ack = float(rec.get("wire_ack_us", max(0.0, push - sum_us)))
+    return {
+        "queue": float(rec.get("queue_us", 0)),
+        "compress": float(rec.get("comp_us", 0)),
+        "wire_ack": wire_ack,
+        "server_sum": min(sum_us, push) if push else sum_us,
+        "pull_wait": float(rec.get("pull_us", 0)),
+        "decode": float(rec.get("dec_us", 0)),
+    }
+
+
+def round_wall_us(rec: dict) -> float:
+    return sum(stage_breakdown(rec).values())
+
+
+def dominant_stage(rec: dict) -> Tuple[str, float]:
+    """(stage, share-of-wall) for the stage that bound this record;
+    ("idle", 0.0) for an empty record."""
+    bd = stage_breakdown(rec)
+    wall = sum(bd.values())
+    if wall <= 0:
+        return "idle", 0.0
+    stage = max(STAGES, key=lambda s: bd[s])
+    return stage, bd[stage] / wall
+
+
+def merge_recs(recs: Iterable[dict]) -> dict:
+    """Elementwise sum of round records — the fleet-wide view of one
+    round (or of each rank's latest round). ``round`` keeps the max,
+    not the sum (it is an identity, not a quantity)."""
+    recs = [r for r in recs if r]
+    out: Dict[str, float] = {}
+    for rec in recs:
+        for k, v in rec.items():
+            if isinstance(v, (int, float)):
+                out[k] = out.get(k, 0) + v
+    if recs and "round" in out:
+        out["round"] = max(int(r.get("round", -1)) for r in recs)
+    return out
+
+
+def classify(workers: Dict[str, dict], straggler_factor: float = 2.0,
+             retry_threshold: int = 1,
+             dominance: float = DOMINANCE_SHARE) -> dict:
+    """Fleet state from per-worker round records (one record per
+    worker — normally each rank's latest completed round).
+
+    Precedence: faults first (``retry-degraded``), then skew
+    (``straggler-skewed``), then stage dominance (``wire-bound`` /
+    ``sum-bound``); anything else is ``healthy``. Skew outranks
+    dominance because a paced straggler ALSO inflates wire shares —
+    the skew is the actionable signal there, not the stage.
+    """
+    workers = {k: v for k, v in workers.items() if v}
+    fleet = merge_recs(list(workers.values())) if workers else {}
+    bd = stage_breakdown(fleet) if fleet else {}
+    attrib_wall = sum(bd.get(s, 0.0) for s in ATTRIB_STAGES)
+    if attrib_wall > 0:
+        dom = max(ATTRIB_STAGES, key=lambda s: bd[s])
+        share = bd[dom] / attrib_wall
+    else:
+        dom, share = "idle", 0.0
+    retries = int(fleet.get("retries", 0))
+
+    # Per-rank mean per-partition push wall (monitor.top's metric).
+    push_means = {}
+    for name, rec in workers.items():
+        parts = int(rec.get("parts", 0))
+        if parts > 0:
+            push_means[name] = float(rec.get("push_us", 0)) / parts
+    baseline = (statistics.median_low(list(push_means.values()))
+                if push_means else 0.0)
+    stragglers = sorted(
+        n for n, m in push_means.items()
+        if m >= PUSH_FLOOR_US and m > straggler_factor * baseline)
+
+    if retries >= retry_threshold:
+        state = "retry-degraded"
+    elif stragglers:
+        state = "straggler-skewed"
+    elif dom == "wire_ack" and share >= dominance:
+        state = "wire-bound"
+    elif dom == "server_sum" and share >= dominance:
+        state = "sum-bound"
+    else:
+        state = "healthy"
+    return {
+        "state": state,
+        "dominant": dom,
+        "dominant_share": round(share, 3),
+        "fleet": fleet,
+        "stragglers": stragglers,
+        "baseline_push_us": baseline,
+        "retries": retries,
+    }
+
+
+def regressions(fleet: Dict[str, dict],
+                factor: float = REGRESS_FACTOR) -> List[str]:
+    """Ranks whose latest round wall exceeds factor x their EWMA
+    baseline (``fleet`` is the scheduler snapshot's per-rank section:
+    {node: {"last": rec, "ewma_wall_us": x, "updates": n}})."""
+    out = []
+    for node, st in fleet.items():
+        if int(st.get("updates", 0)) < REGRESS_MIN_UPDATES:
+            continue
+        ewma = float(st.get("ewma_wall_us", 0.0))
+        if ewma > 0 and round_wall_us(st.get("last", {})) > factor * ewma:
+            out.append(node)
+    return sorted(out)
+
+
+def hints(state: str, fleet_rec: dict) -> List[str]:
+    """Advisory tuning hints naming the knob. NEVER actuated here —
+    the observability layer stays a sensor (docs/monitoring.md)."""
+    out: List[str] = []
+    parts = max(1, int(fleet_rec.get("parts", 0)))
+    msgs_per_part = float(fleet_rec.get("wire_msgs", 0)) / parts
+    fused = int(fleet_rec.get("fused_frames", 0))
+    bd = stage_breakdown(fleet_rec)
+    wall = sum(bd.values()) or 1.0
+    if state == "wire-bound":
+        if msgs_per_part > 1.5 and fused == 0:
+            out.append(
+                "wire_msgs dominate (%.1f frames/partition, none fused)"
+                " -> raise BYTEPS_FUSION_BYTES so small tensors coalesce"
+                % msgs_per_part)
+        else:
+            out.append(
+                "wire transit bounds the round -> raise "
+                "BYTEPS_VAN_STREAMS (per-stream cwnd cap) and check "
+                "BYTEPS_SOCKET_BUF >= the link BDP")
+    elif state == "sum-bound":
+        out.append(
+            "server summation bounds the round -> raise "
+            "BYTEPS_SERVER_ENGINE_THREAD or add server ranks "
+            "(DMLC_NUM_SERVER)")
+    elif state == "straggler-skewed":
+        out.append(
+            "one rank's push wall gates the fleet -> inspect that "
+            "host's NIC/pacing/CPU before touching fleet-wide knobs")
+    elif state == "retry-degraded":
+        out.append(
+            "resends are burning round time -> inspect link loss; if "
+            "rounds are healthy-but-slow, raise BYTEPS_RETRY_TIMEOUT_MS "
+            "so the timer stops re-sending live requests")
+    if bd["queue"] / wall >= DOMINANCE_SHARE:
+        out.append(
+            "scheduled-queue wait dominates the wall -> raise "
+            "BYTEPS_SCHEDULING_CREDIT if credit-limited; otherwise the "
+            "queue is draining at the bound stage's rate (fix that "
+            "first)")
+    if bd["compress"] / wall >= DOMINANCE_SHARE:
+        out.append(
+            "encode cost dominates -> larger BYTEPS_WIRE_QUANT_BLOCK "
+            "(fewer scales) or drop the codec on small keys "
+            "(BYTEPS_WIRE_QUANT_MIN_BYTES)")
+    if int(fleet_rec.get("parked", 0)) > parts:
+        out.append(
+            "server parks exceed partitions -> deep pipelining is "
+            "outrunning slot recycling; fewer in-flight rounds or more "
+            "servers")
+    return out
+
+
+def analyze(summary: dict, straggler_factor: float = 2.0,
+            regress_factor: float = REGRESS_FACTOR) -> dict:
+    """Full report from one ``bps_round_summary`` snapshot (normally the
+    SCHEDULER's, whose ``fleet`` section holds every rank's summaries).
+    Falls back to the local ring when no fleet data is present."""
+    fleet = summary.get("fleet", {}) or {}
+    workers = {node: st.get("last", {}) for node, st in fleet.items()
+               if st.get("role") == 2}
+    local_only = False
+    if not workers:
+        last = summary.get("last")
+        workers = {str(summary.get("node_id", -1)): last} if last else {}
+        local_only = True
+    rep = classify(workers, straggler_factor=straggler_factor)
+    rep["regressions"] = regressions(
+        {n: st for n, st in fleet.items() if st.get("role") == 2},
+        factor=regress_factor)
+    rep["hints"] = hints(rep["state"], rep["fleet"])
+    rep["local_only"] = local_only
+    rep["workers"] = workers
+    rep["rounds_seen"] = sorted(
+        int(r) for r in summary.get("fleet_rounds", {}))
+    return rep
+
+
+# --- live CLI ---------------------------------------------------------------
+
+def _fmt_us(us: float) -> str:
+    return f"{us / 1e3:.2f}ms" if us >= 1000 else f"{us:.0f}us"
+
+
+def scrape_rounds(endpoint: str, timeout: float = 2.0) -> Optional[dict]:
+    """Fetch one /rounds snapshot; None when unreachable."""
+    try:
+        with urllib.request.urlopen(f"http://{endpoint}/rounds",
+                                    timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except (OSError, ValueError):
+        return None
+
+
+def print_round_line(round_no: int, recs: Dict[str, dict],
+                     file=None) -> None:
+    """One scrolling line per fleet round: wall, bottleneck, state."""
+    out = file or sys.stdout
+    fleet = merge_recs(list(recs.values()))
+    dom, share = dominant_stage(fleet)
+    rep = classify(recs)
+    print(f"round {round_no:>6}  wall {_fmt_us(round_wall_us(fleet)):>9}  "
+          f"bottleneck {dom}({share * 100:.0f}%)  "
+          f"state {rep['state'].upper()}  "
+          f"wire {int(fleet.get('wire_bytes', 0)) >> 10}K/"
+          f"{int(fleet.get('wire_msgs', 0))}msg"
+          + (f"  retries {int(fleet.get('retries', 0))}"
+             if fleet.get("retries") else ""), file=out,
+          flush=True)  # watch mode is tail/pipe-friendly
+
+
+def print_report(rep: dict, file=None) -> None:
+    out = file or sys.stdout
+    print(f"fleet state: {rep['state'].upper()} "
+          f"(bottleneck {rep['dominant']} "
+          f"{rep['dominant_share'] * 100:.0f}% of round wall"
+          + (", local ring only — scrape the scheduler for fleet view"
+             if rep.get("local_only") else "") + ")", file=out)
+    bd = stage_breakdown(rep["fleet"])
+    print("  " + "  ".join(f"{s}={_fmt_us(bd[s])}" for s in STAGES),
+          file=out)
+    if rep["stragglers"]:
+        print(f"  stragglers: {rep['stragglers']} "
+              f"(baseline push {_fmt_us(rep['baseline_push_us'])}/part)",
+              file=out)
+    if rep["regressions"]:
+        print(f"  regressions vs EWMA baseline: {rep['regressions']}",
+              file=out)
+    for h in rep["hints"]:
+        print(f"  hint: {h}", file=out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m byteps_tpu.monitor.insight",
+        description="live per-round bottleneck attribution from the "
+                    "scheduler's fleet round table "
+                    "(docs/monitoring.md 'Round insight')")
+    p.add_argument("--endpoint", default="",
+                   help="scheduler monitor endpoint host:port (default: "
+                        "DMLC_PS_ROOT_URI:BYTEPS_MONITOR_PORT — the "
+                        "scheduler is node 0, so the base port IS its "
+                        "port)")
+    p.add_argument("--watch", type=float, metavar="SECONDS", default=0,
+                   help="poll every N seconds, printing one line per "
+                        "newly completed fleet round")
+    p.add_argument("--straggler-factor", type=float,
+                   default=float(os.environ.get("BYTEPS_STRAGGLER_FACTOR",
+                                                "2.0")))
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report (one JSON object per "
+                        "poll)")
+    args = p.parse_args(argv)
+
+    endpoint = args.endpoint or "%s:%s" % (
+        os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+        os.environ.get("BYTEPS_MONITOR_PORT", "9100"))
+    last_printed = -1
+    while True:
+        summary = scrape_rounds(endpoint)
+        if summary is None:
+            print(f"endpoint {endpoint} unreachable — is the scheduler "
+                  "running with BYTEPS_MONITOR_ON=1?", file=sys.stderr)
+            if not args.watch:
+                return 1
+            time.sleep(args.watch)
+            continue
+        rep = analyze(summary, straggler_factor=args.straggler_factor)
+        if args.json:
+            rep2 = dict(rep)
+            print(json.dumps(rep2))
+        elif args.watch:
+            table = summary.get("fleet_rounds", {})
+            for rnd in sorted(int(r) for r in table):
+                if rnd > last_printed:
+                    print_round_line(rnd, table[str(rnd)])
+                    last_printed = rnd
+        else:
+            print_report(rep)
+        if not args.watch:
+            return 0 if rep["state"] == "healthy" else 2
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,7 +41,10 @@ from typing import Dict, List, Optional, Tuple
 _ROLE_NAMES = {0: "scheduler", 1: "server", 2: "worker"}
 
 # Worker/server span names the critical-path report attributes.
-_WORKER_SPANS = ("compress", "push", "pull")
+# qencode/qdecode (ISSUE 7 satellite): the quantized wire's encode/EF
+# fold and reply-leg dequant, previously invisible under "compress" /
+# inside the pull span.
+_WORKER_SPANS = ("compress", "qencode", "push", "pull", "qdecode")
 _SERVER_SPANS = ("s_sum", "s_reply")
 
 
@@ -65,6 +68,11 @@ def _rank_label(meta: dict) -> str:
     nid = meta.get("node_id", -1)
     if role == "worker" and meta.get("worker_rank", -1) >= 0:
         return f"worker {meta['worker_rank']} (node {nid})"
+    if nid < 0:
+        # Pre-topology dump (a rank that died before learning its id):
+        # the pid is the only attribution; SetNode renames survivors'
+        # files to role/node form, but the merge tolerates both.
+        return f"{role} (pid {meta.get('pid', '?')})"
     return f"{role} (node {nid})"
 
 
@@ -146,6 +154,10 @@ def _span_index(dumps: List[dict]) -> Tuple[list, list, dict]:
                    "key": args.get("key"), "peer": args.get("peer", -1),
                    "req": args.get("req", -1),
                    "round": args.get("round", -1),
+                   # Byte labels on data-carrying spans (quantized
+                   # wire): what crossed the wire vs the decoded size.
+                   "wire_bytes": args.get("wire_bytes", 0),
+                   "raw_bytes": args.get("raw_bytes", 0),
                    "label": _rank_label(meta)}
             if e.get("ph") == "X":
                 if role == 2 and e.get("name") in _WORKER_SPANS:
@@ -193,12 +205,18 @@ def critical_path(dumps: List[dict],
 
     for w in wspans:
         wb = per_worker.setdefault(
-            w["label"], {"push_count": 0, "stages": {}})
+            w["label"], {"push_count": 0, "stages": {},
+                         "push_wire_bytes": 0, "push_raw_bytes": 0})
         rb = per_round.setdefault(w["round"], {})
         stage_add(wb["stages"], w["name"], w["dur"])
         stage_add(rb, w["name"], w["dur"])
         if w["name"] == "push":
             wb["push_count"] += 1
+            # Quantized-vs-raw freight: a push span whose wire bytes
+            # undercut its raw bytes shipped the int8 encoding.
+            if w.get("raw_bytes", 0) > 0:
+                wb["push_wire_bytes"] += w.get("wire_bytes", 0)
+                wb["push_raw_bytes"] += w["raw_bytes"]
             q = enq.get((w["pid"], w["key"], w["round"]))
             if q is not None and w["ts"] >= q:
                 stage_add(wb["stages"], "queue", w["ts"] - q)
@@ -251,8 +269,8 @@ def print_report(report: dict, flow_stats: Optional[dict] = None,
                  file=None) -> None:
     out = file or sys.stdout
     fleet = report["fleet_stages_us"]
-    order = ("queue", "compress", "push", "wire_ack", "server_sum",
-             "pull")
+    order = ("queue", "compress", "qencode", "push", "wire_ack",
+             "server_sum", "pull", "qdecode")
     print("fleet critical-path totals (worker-observed):", file=out)
     for stage in order:
         if stage in fleet:
@@ -262,8 +280,16 @@ def print_report(report: dict, flow_stats: Optional[dict] = None,
         flag = " STRAGGLER" if name in report["stragglers"] else ""
         stages = " ".join(f"{s}={_fmt_us(u)}"
                           for s, u in sorted(wb["stages"].items()))
+        quant = ""
+        if wb.get("push_raw_bytes", 0) > 0:
+            wire = wb.get("push_wire_bytes", 0)
+            raw = wb["push_raw_bytes"]
+            kind = "quantized" if wire < raw else "raw"
+            quant = (f" push_bytes={wire >> 10}K/{raw >> 10}K"
+                     f" ({kind})")
         print(f"  {name}: pushes={wb['push_count']} "
-              f"mean_push={_fmt_us(mean)} {stages}{flag}", file=out)
+              f"mean_push={_fmt_us(mean)} {stages}{quant}{flag}",
+              file=out)
     for name, sb in sorted(report["per_server"].items()):
         stages = " ".join(f"{s}={_fmt_us(u)}"
                           for s, u in sorted(sb.items()))

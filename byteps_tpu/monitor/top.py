@@ -112,6 +112,34 @@ def analyze(scrapes: Dict[str, Optional[dict]],
         qs = workers[name]["quant_saved_bytes"]
         workers[name]["quant_ratio"] = (
             round((qw + qs) / qw, 2) if qw > 0 else 1.0)
+        # Last completed round's stage breakdown (ISSUE 7): the
+        # BOTTLENECK column + fleet-state header come from these
+        # gauges through the same insight classifier the /rounds
+        # watcher uses.
+        rec = {
+            "round": int(_sample(m, "bps_round_last", -1)),
+            "parts": int(_sample(m, "bps_round_parts")),
+            "queue_us": _sample(m, "bps_round_queue_us"),
+            "comp_us": _sample(m, "bps_round_comp_us"),
+            "push_us": _sample(m, "bps_round_push_us"),
+            "sum_us": _sample(m, "bps_round_sum_us"),
+            "wire_ack_us": _sample(m, "bps_round_wire_ack_us"),
+            "pull_us": _sample(m, "bps_round_pull_us"),
+            "dec_us": _sample(m, "bps_round_dec_us"),
+            "wire_bytes": int(_sample(m, "bps_round_wire_bytes")),
+            "wire_msgs": int(_sample(m, "bps_round_wire_msgs")),
+            "retries": int(_sample(m, "bps_round_retries")),
+            "parked": int(_sample(m, "bps_round_parked")),
+        }
+        workers[name]["round"] = rec
+        if rec["round"] >= 0:
+            from byteps_tpu.monitor import insight
+            stage, share = insight.dominant_stage(rec)
+            workers[name]["bottleneck"] = stage
+            workers[name]["bottleneck_share"] = round(share, 2)
+        else:
+            workers[name]["bottleneck"] = "-"
+            workers[name]["bottleneck_share"] = 0.0
 
     # A worker actively riding the retry layer is flagged separately
     # from stragglers: its latency may still look healthy while its
@@ -150,6 +178,19 @@ def analyze(scrapes: Dict[str, Optional[dict]],
         recovering = recovering or bool(_sample(sched, "bps_recovering"))
         recoveries = int(_sample(sched, "bps_recoveries_total"))
 
+    # Fleet state (ISSUE 7): classify the workers' last-round records
+    # with the same rules the /rounds watcher applies.
+    fleet_state = "idle"
+    fleet_bottleneck = "-"
+    round_recs = {n: w["round"] for n, w in workers.items()
+                  if w.get("round", {}).get("round", -1) >= 0}
+    if round_recs:
+        from byteps_tpu.monitor import insight
+        rep = insight.classify(round_recs,
+                               straggler_factor=straggler_factor)
+        fleet_state = rep["state"]
+        fleet_bottleneck = rep["dominant"]
+
     return {
         "workers": workers,
         "baseline_push_us": baseline_us,
@@ -163,6 +204,9 @@ def analyze(scrapes: Dict[str, Optional[dict]],
         "epoch": epoch,
         "recovering": recovering,
         "recoveries": recoveries,
+        # Per-round insight (docs/monitoring.md "Round insight").
+        "fleet_state": fleet_state,
+        "fleet_bottleneck": fleet_bottleneck,
     }
 
 
@@ -172,13 +216,17 @@ def _print_report(report: dict, as_json: bool) -> None:
         return
     print(f"{'worker':<10} {'push/s':>8} {'push MB':>9} {'pull MB':>9} "
           f"{'q-ratio':>7} {'mean push':>10} {'queue':>6} {'credit':>14} "
-          f"{'rtry':>5} {'reconn':>6} flags")
+          f"{'rtry':>5} {'reconn':>6} {'BOTTLENECK':>14} flags")
     if report.get("recovering"):
         print(f"fleet: RECOVERING (membership epoch {report['epoch']}; "
               "a server rank is being hot-replaced)")
     elif report.get("epoch"):
         print(f"fleet: epoch {report['epoch']} "
               f"({report.get('recoveries', 0)} recovery(ies) completed)")
+    if report.get("fleet_state", "idle") != "idle":
+        print(f"fleet: {report['fleet_state'].upper()} "
+              f"(round bottleneck: {report['fleet_bottleneck']}; "
+              "details: python -m byteps_tpu.monitor.insight)")
     for name in sorted(report["workers"]):
         w = report["workers"][name]
         flags = []
@@ -196,12 +244,16 @@ def _print_report(report: dict, as_json: bool) -> None:
                   f"{w['credit_budget_bytes'] >> 10}K")
         qratio = (f"{w['quant_ratio']:.1f}x"
                   if w.get("quant_wire_bytes") else "-")
+        bneck = w.get("bottleneck", "-")
+        if bneck != "-":
+            bneck = f"{bneck}({w.get('bottleneck_share', 0) * 100:.0f}%)"
         print(f"{name:<10} {w['push_count']:>8} "
               f"{w['push_bytes'] / 1e6:>9.2f} {w['pull_bytes'] / 1e6:>9.2f} "
               f"{qratio:>7} "
               f"{w['push_mean_us'] / 1e3:>8.2f}ms {w['queue_pending']:>6} "
               f"{credit:>14} {w.get('retries', 0):>5} "
-              f"{w.get('reconnects', 0):>6} {' '.join(flags)}")
+              f"{w.get('reconnects', 0):>6} {bneck:>14} "
+              f"{' '.join(flags)}")
     for kind in ("retrying", "stale_nodes", "dead_nodes", "unreachable"):
         if report.get(kind):
             print(f"{kind}: {report[kind]}")

@@ -42,7 +42,22 @@ def main() -> int:
         return jax_async_seed_main()
     if mode == "jax_bucketed":
         return jax_bucketed_main()
+    if os.environ.get("BPS_TEST_PREINIT_FLIGHT"):
+        # Flight-dump rename (ISSUE 7 satellite): a dump taken before
+        # the topology exists can only be pid-named; once bps_init
+        # learns this rank's identity, SetNode must rename the file to
+        # the canonical role/node form (asserted after start below).
+        from byteps_tpu.core.ffi import _load
+        _load().bps_dump_flight(None)
     w = Worker.start()
+    if os.environ.get("BPS_TEST_PREINIT_FLIGHT"):
+        td = os.environ.get("BYTEPS_TRACE_DIR") or "./traces"
+        pid_file = os.path.join(td, f"flight_r-1_pid{os.getpid()}.json")
+        new_file = os.path.join(td, f"flight_r2_n{w.node_id}.json")
+        assert not os.path.exists(pid_file), \
+            f"pre-topology dump not renamed: {pid_file}"
+        assert os.path.exists(new_file), \
+            f"renamed flight dump missing: {new_file}"
     rank = w.worker_rank()
     nw = w.num_workers()
     rng = np.random.default_rng(1234)  # same stream on all workers
@@ -512,6 +527,63 @@ def main() -> int:
             while go and not os.path.exists(go) and time.time() < deadline:
                 time.sleep(0.2)
 
+        elif mode == "insight_hold":
+            # Per-round introspection harness (ISSUE 7): R comm-only
+            # rounds over parameterized keys, then print this worker's
+            # round-gauge snapshot + local round summary and hold the
+            # fleet (go-file) while the parent scrapes the scheduler's
+            # /rounds fleet table. Key shape/count and round count come
+            # from env so one mode serves both the wire-starved
+            # (fusion off, sub-64KB keys) and the pacing-straggler
+            # variants.
+            import json
+            import time
+
+            nelem = int(os.environ.get("BPS_TEST_INSIGHT_N", "2048"))
+            nkeys = int(os.environ.get("BPS_TEST_INSIGHT_KEYS", "24"))
+            rounds = int(os.environ.get("BPS_TEST_INSIGHT_ROUNDS", "6"))
+            tids = [w.declare(f"in{i}", nelem, "float32", compression="")
+                    for i in range(nkeys)]
+            for rnd in range(rounds):
+                staged = []
+                for i, tid in enumerate(tids):
+                    base = (np.arange(nelem) % 31 + i + rnd + 1).astype(
+                        np.float32)
+                    arr = np.ascontiguousarray(base * (rank + 1))
+                    staged.append((w.push_pull(tid, arr, average=False),
+                                   arr, base))
+                scale = sum(r + 1 for r in range(nw))
+                for h, arr, base in staged:
+                    w.wait(h)
+                    np.testing.assert_array_equal(arr, base * scale)
+            # Sentinel round: a round only finalizes into the ring when
+            # a LATER round starts (mid-step completions must not split
+            # records), so one extra single-key push closes round R-1.
+            sent = np.ones(nelem, np.float32)
+            w.wait(w.push_pull(tids[0], sent, average=False))
+            # Let at least one heartbeat ship the freshly closed rounds
+            # to the scheduler before the parent scrapes (interval 1s).
+            time.sleep(2.5)
+            w.barrier(GROUP_WORKERS)  # all rounds' gauges final
+            snap = w.metrics_snapshot()
+            from byteps_tpu.core.ffi import round_summary
+            local = round_summary()
+            print(json.dumps({
+                "node_id": snap["node"]["id"],
+                "rounds_completed": snap["counters"].get(
+                    "bps_rounds_completed_total", 0),
+                "gauges": {k: v for k, v in snap["gauges"].items()
+                           if k.startswith("bps_round_")},
+                "local_last": local["last"],
+                "local_rounds": [r["round"] for r in local["rounds"]],
+            }), flush=True)
+            print("ready", flush=True)
+            go = os.environ.get("BPS_TEST_GO_FILE", "")
+            deadline = time.time() + 60
+            while go and not os.path.exists(go) and time.time() < deadline:
+                time.sleep(0.2)
+            w.barrier(GROUP_WORKERS)
+
         elif mode == "fusion":
             # Small-tensor fusion acceptance: a conv-net-shaped flood of
             # tiny tensors must aggregate EXACTLY (integer-valued floats,
@@ -681,11 +753,44 @@ def main() -> int:
             w.barrier(GROUP_WORKERS)  # all counters final
             snap = w.metrics_snapshot()["counters"]
             parity = None
+            sched_fleet_workers = None
             mport = int(os.environ.get("BYTEPS_MONITOR_PORT", "0"))
             if rank == 0 and mport:
+                # Round summaries flowing under quant+chaos (ISSUE 7
+                # acceptance): poll the scheduler's /rounds until its
+                # fleet table holds every worker's heartbeat summaries
+                # (heartbeats are control-plane: chaos never touches
+                # them, so summaries must arrive even mid-fault).
+                import time as _time
+                deadline = _time.time() + 10
+                while _time.time() < deadline:
+                    try:
+                        with urllib.request.urlopen(
+                                f"http://127.0.0.1:{mport}/rounds",
+                                timeout=5) as r:
+                            fleet = json.loads(r.read().decode())[
+                                "fleet"]
+                        sched_fleet_workers = sum(
+                            1 for st in fleet.values()
+                            if st.get("role") == 2
+                            and st.get("updates", 0) > 0)
+                        if sched_fleet_workers >= nw:
+                            break
+                    except OSError:
+                        pass
+                    _time.sleep(0.5)
                 # Push-byte parity under quant: both sides must count
                 # ENCODED wire bytes (the PR 2 contract, re-proven on
-                # the quantized wire).
+                # the quantized wire). NOT asserted under chaos: the
+                # server counts every ARRIVAL (retry resends and chaos
+                # dups included) while the worker counts each partition
+                # once, so injected faults legitimately skew the sums —
+                # and a failed assert here would skip the final barrier
+                # and wedge the peer worker in it forever.
+                chaos_armed = any(
+                    float(os.environ.get(v, "0") or 0) > 0
+                    for v in ("BYTEPS_CHAOS_DROP", "BYTEPS_CHAOS_DUP",
+                              "BYTEPS_CHAOS_RESET_EVERY"))
                 ns = int(os.environ["DMLC_NUM_SERVER"])
 
                 def scrape(port):
@@ -694,15 +799,16 @@ def main() -> int:
                             timeout=5) as r:
                         return parse_prometheus(r.read().decode())
 
-                worker_push = sum(
-                    scrape(mport + 1 + ns + r)["bps_push_bytes_total"][()]
-                    for r in range(nw))
-                server_recv = sum(
-                    scrape(mport + 1 + s)["bps_recv_bytes_total"][()]
-                    for s in range(ns))
-                assert worker_push == server_recv, (worker_push,
-                                                    server_recv)
-                parity = [worker_push, server_recv]
+                if not chaos_armed:
+                    worker_push = sum(
+                        scrape(mport + 1 + ns + r)
+                        ["bps_push_bytes_total"][()] for r in range(nw))
+                    server_recv = sum(
+                        scrape(mport + 1 + s)["bps_recv_bytes_total"][()]
+                        for s in range(ns))
+                    assert worker_push == server_recv, (worker_push,
+                                                        server_recv)
+                    parity = [worker_push, server_recv]
             print(json.dumps({
                 "digest": digest.hexdigest(),
                 "quant_wire": snap.get("bps_quant_bytes_on_wire_total",
@@ -717,6 +823,10 @@ def main() -> int:
                 "chaos_injected": snap.get("bps_chaos_injected_total",
                                            0),
                 "parity": parity,
+                # Round-insight composition evidence (ISSUE 7).
+                "rounds_completed": snap.get(
+                    "bps_rounds_completed_total", 0),
+                "sched_fleet_workers": sched_fleet_workers,
             }), flush=True)
             # Hold the fleet until rank 0 finished scraping everyone.
             w.barrier(GROUP_WORKERS)

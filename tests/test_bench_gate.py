@@ -1,0 +1,118 @@
+"""Bench regression gate tests (ISSUE 7 satellite).
+
+Fast tier. Includes the tier-1 CI wiring the issue asks for: every
+in-tree BENCH artifact must pass ``bench_gate.py --check-format``
+(schema-only, no fleet), so a malformed artifact fails fast in the
+same run that would otherwise trust it.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+import bench_gate  # noqa: E402
+
+
+def test_family_parsing():
+    assert bench_gate.family_of("/x/BENCH_trace_r06.json") == ("trace", 6)
+    assert bench_gate.family_of("/x/BENCH_scaling_r05.json") == \
+        ("scaling", 5)
+    assert bench_gate.family_of("/x/BENCH_r01.json") == ("core", 1)
+    assert bench_gate.family_of("/x/BENCH_fusion.json") is None
+    assert bench_gate.family_of("/x/MULTICHIP_r01.json") is None
+
+
+def test_flatten_numeric_leaves_only():
+    flat = bench_gate.flatten({
+        "summary": {"steps_per_s": 10.5, "note": "text", "ok": True},
+        "runs": [{"v": 1}, {"v": 2}],
+    })
+    assert flat == {"summary.steps_per_s": 10.5, "runs.0.v": 1.0,
+                    "runs.1.v": 2.0}
+
+
+def test_direction_inference():
+    assert bench_gate.direction("summary.steps_per_s_off") == 1
+    assert bench_gate.direction("reducer_gbps") == 1
+    assert bench_gate.direction("trace_on_overhead_pct") == -1
+    assert bench_gate.direction("push_mean_us") == -1
+    assert bench_gate.direction("wire_bytes") == 0  # unknown: info only
+
+
+def test_compare_flags_regressions_by_direction():
+    prev = {"s": {"steps_per_s": 100.0, "overhead_pct": 3.0,
+                  "wire_bytes": 500}}
+    # throughput down 30%, overhead up 3x, bytes moved (info only)
+    new = {"s": {"steps_per_s": 70.0, "overhead_pct": 9.0,
+                 "wire_bytes": 900}}
+    rows = {r["metric"]: r for r in
+            bench_gate.compare(prev, new, threshold=0.15)}
+    assert rows["s.steps_per_s"]["status"] == "FAIL"
+    assert rows["s.overhead_pct"]["status"] == "FAIL"
+    assert rows["s.wire_bytes"]["status"] == "info"
+    # within threshold passes
+    ok = {"s": {"steps_per_s": 90.0, "overhead_pct": 3.2,
+                "wire_bytes": 500}}
+    rows = {r["metric"]: r for r in
+            bench_gate.compare(prev, ok, threshold=0.15)}
+    assert rows["s.steps_per_s"]["status"] == "PASS"
+    assert rows["s.overhead_pct"]["status"] == "PASS"
+
+
+def test_compare_ignores_unshared_metrics():
+    rows = bench_gate.compare({"a": {"steps_per_s": 1.0}},
+                              {"b": {"steps_per_s": 2.0}})
+    assert rows == []
+
+
+def test_gate_family_end_to_end(tmp_path):
+    (tmp_path / "BENCH_x_r01.json").write_text(
+        json.dumps({"summary": {"steps_per_s": 100.0}}))
+    (tmp_path / "BENCH_x_r02.json").write_text(
+        json.dumps({"summary": {"steps_per_s": 50.0}}))
+    rc = bench_gate.main(["--repo", str(tmp_path)])
+    assert rc == 1  # regression -> nonzero
+    (tmp_path / "BENCH_x_r02.json").write_text(
+        json.dumps({"summary": {"steps_per_s": 101.0}}))
+    assert bench_gate.main(["--repo", str(tmp_path)]) == 0
+    # a single-round family has nothing to gate against
+    (tmp_path / "BENCH_y_r01.json").write_text(json.dumps({"v": 1}))
+    assert bench_gate.main(["--repo", str(tmp_path)]) == 0
+
+
+def test_check_format_catches_malformed(tmp_path):
+    (tmp_path / "BENCH_ok_r01.json").write_text(
+        json.dumps({"steps_per_s": 1.0}))
+    assert bench_gate.check_format(str(tmp_path)) == []
+    (tmp_path / "BENCH_broken_r01.json").write_text("{not json")
+    (tmp_path / "BENCH_empty_r01.json").write_text("{}")
+    (tmp_path / "BENCH_nonum_r01.json").write_text(
+        json.dumps({"what": "words only"}))
+    bad = bench_gate.check_format(str(tmp_path))
+    assert len(bad) == 3
+    assert bench_gate.main(["--repo", str(tmp_path),
+                            "--check-format"]) == 1
+
+
+def test_in_tree_bench_artifacts_are_well_formed():
+    """The tier-1 wiring: every committed BENCH_*.json must be a
+    parseable, non-empty JSON object with at least one numeric metric."""
+    bad = bench_gate.check_format()
+    assert bad == [], f"malformed bench artifacts: {bad}"
+    assert len(bench_gate.find_bench_files()) > 20  # the corpus exists
+
+
+def test_in_tree_families_gate_clean():
+    """Whole-repo gate run must not crash; regressions are reported via
+    exit code, asserted separately per-PR (new artifacts are appended
+    with their own A/B evidence)."""
+    reports = []
+    for name, rounds in sorted(bench_gate.families().items()):
+        rep = bench_gate.gate_family(name, rounds, threshold=0.15)
+        if rep:
+            reports.append(rep)
+    assert reports, "expected at least one multi-round family in-tree"

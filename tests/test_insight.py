@@ -1,0 +1,302 @@
+"""Per-round introspection unit tests (ISSUE 7).
+
+Fast tier: the C-core round ring (wraparound, drop counters, finalize
+rules) driven through the real bps_round_track FFI path; heartbeat
+summary wire-format version interop through bps_round_ingest; and the
+insight classification engine's state boundaries on synthetic
+summaries — every fleet state reachable.
+"""
+
+import struct
+
+import pytest
+
+from byteps_tpu.monitor import insight
+
+# Wire layout mirrors csrc/roundstats.h (packed).
+_HDR = struct.Struct("<HHiiiqq")
+_REC = struct.Struct("<ii7q4i")
+_MAGIC = 0xB57A
+_VERSION = 1
+
+
+def _pack_rec(round_no, parts=4, queue=10, comp=5, push=100, sum_us=40,
+              pull=50, dec=5, wire_bytes=4096, wire_msgs=8, fused=0,
+              retries=0, parked=0):
+    return _REC.pack(round_no, parts, queue, comp, push, sum_us, pull,
+                     dec, wire_bytes, wire_msgs, fused, retries, parked)
+
+
+def _pack_summary(node_id, recs, role=2, magic=_MAGIC, version=_VERSION,
+                  completed=None, dropped=0):
+    hdr = _HDR.pack(magic, version, node_id, role, len(recs),
+                    completed if completed is not None else len(recs),
+                    dropped)
+    return hdr + b"".join(recs)
+
+
+# --- C ring via FFI (no topology needed) -----------------------------------
+
+def _drive_round(ffi, r, parts=2, push=100, sum_us=40, pull=50,
+                 retries=0):
+    for _ in range(parts):
+        ffi.round_track("enq", r)
+    for _ in range(parts):
+        ffi.round_track("queue", r, 10)
+        ffi.round_track("frame", r)
+        ffi.round_track("push", r, push, 1024)
+        ffi.round_track("sum", r, sum_us)
+        ffi.round_track("frame", r)
+        ffi.round_track("pull", r, pull, 1024)
+    for _ in range(retries):
+        ffi.round_track("retry", r)
+    for _ in range(parts):
+        ffi.round_track("done", r)
+
+
+def test_round_ring_accumulates_and_finalizes():
+    """A balanced round finalizes once a NEWER round starts (mid-step
+    completion of one tensor must not split the round), and the record
+    carries the per-stage sums + derived wire_ack."""
+    from byteps_tpu.core import ffi
+
+    base = ffi.round_summary()["completed_total"]
+    start = 1_000_000  # round-number namespace away from other tests
+    _drive_round(ffi, start, parts=3, push=200, sum_us=80)
+    s = ffi.round_summary()
+    assert all(r["round"] != start for r in s["rounds"]), \
+        "round must stay open until a later round starts"
+    _drive_round(ffi, start + 1)
+    s = ffi.round_summary()
+    assert s["completed_total"] >= base + 1
+    rec = s["last"]
+    assert rec["round"] == start
+    assert rec["parts"] == 3
+    assert rec["push_us"] == 3 * 200
+    assert rec["sum_us"] == 3 * 80
+    assert rec["wire_ack_us"] == 3 * (200 - 80)
+    assert rec["wire_bytes"] == 3 * 2048
+    assert rec["wire_msgs"] == 6
+    assert rec["queue_us"] == 30
+
+
+def test_round_ring_wraparound_and_drop_counter():
+    """Drop-oldest semantics: driving more rounds than the ring holds
+    keeps the newest records and counts the overwritten ones."""
+    from byteps_tpu.core import ffi
+
+    s0 = ffi.round_summary()
+    cap = s0["ring_capacity"]
+    base_done = s0["completed_total"]
+    base_dropped = s0["dropped"]
+    n = cap + 40
+    start = 2_000_000
+    for r in range(start, start + n + 1):
+        _drive_round(ffi, r, parts=1)
+    s = ffi.round_summary()
+    # >= : leftover balanced rounds from earlier tests may finalize too
+    # (the singleton is process-wide).
+    assert base_done + n <= s["completed_total"] <= base_done + n + 8
+    assert s["dropped"] >= base_dropped + 40 - 1
+    assert len(s["rounds"]) == cap
+    # Newest records survive, oldest rotated out.
+    rounds = [r["round"] for r in s["rounds"]]
+    assert rounds == sorted(rounds)
+    assert rounds[-1] == start + n - 1
+    assert rounds[0] >= start + n - cap
+
+
+def test_round_open_table_is_bounded():
+    """Rounds that never balance (failed handles) are force-finalized
+    once the open table overflows — the ring keeps moving."""
+    from byteps_tpu.core import ffi
+
+    base = ffi.round_summary()["completed_total"]
+    start = 3_000_000
+    for r in range(start, start + 20):
+        ffi.round_track("enq", r)
+        ffi.round_track("push", r, 10, 1)
+        # never done: the ledger stays unbalanced
+    s = ffi.round_summary()
+    assert s["completed_total"] > base, \
+        "open table must force-finalize wedged rounds"
+
+
+def test_ingest_version_interop():
+    """Only the known magic+version is accepted; short frames and
+    foreign generations are ignored (mixed-fleet heartbeats interop)."""
+    from byteps_tpu.core import ffi
+
+    good = _pack_summary(41, [_pack_rec(7)])
+    assert ffi.round_ingest(good)
+    assert not ffi.round_ingest(_pack_summary(41, [_pack_rec(8)],
+                                              magic=0x1234))
+    assert not ffi.round_ingest(_pack_summary(41, [_pack_rec(8)],
+                                              version=_VERSION + 1))
+    assert not ffi.round_ingest(good[:20])  # short frame
+    # count larger than the payload actually carries
+    bad_count = _HDR.pack(_MAGIC, _VERSION, 41, 2, 5, 5, 0) + _pack_rec(9)
+    assert not ffi.round_ingest(bad_count)
+    s = ffi.round_summary()
+    assert "41" in s["fleet"]
+    assert s["fleet"]["41"]["last"]["round"] == 7, \
+        "rejected payloads must not have touched the fleet table"
+
+
+def test_ingest_builds_fleet_table_and_ewma():
+    from byteps_tpu.core import ffi
+
+    node = 55
+    walls = []
+    for r in range(5):
+        rec = _pack_rec(100 + r, push=1000 * (r + 1), pull=0, queue=0,
+                        comp=0, dec=0, sum_us=0)
+        walls.append(1000.0 * (r + 1))
+        assert ffi.round_ingest(_pack_summary(node, [rec]))
+    s = ffi.round_summary()
+    st = s["fleet"][str(node)]
+    assert st["updates"] == 5
+    assert st["last"]["round"] == 104
+    # EWMA with alpha 0.2, seeded by the first sample.
+    ewma = walls[0]
+    for w in walls[1:]:
+        ewma = 0.8 * ewma + 0.2 * w
+    assert st["ewma_wall_us"] == pytest.approx(ewma, rel=1e-3)
+    for r in range(5):
+        assert str(node) in s["fleet_rounds"][str(100 + r)]
+
+
+# --- classification boundaries (pure python) --------------------------------
+
+def _rec(parts=4, queue=0, comp=0, push=0, sum_us=0, pull=0, dec=0,
+         wire_msgs=0, fused=0, retries=0, parked=0, wire_bytes=0,
+         round_no=10):
+    return {"round": round_no, "parts": parts, "queue_us": queue,
+            "comp_us": comp, "push_us": push, "sum_us": sum_us,
+            "pull_us": pull, "dec_us": dec, "wire_bytes": wire_bytes,
+            "wire_msgs": wire_msgs, "fused_frames": fused,
+            "retries": retries, "parked": parked}
+
+
+def test_classify_wire_bound():
+    w = {n: _rec(push=100_000, sum_us=5_000, pull=10_000)
+         for n in ("3", "4")}
+    rep = insight.classify(w)
+    assert rep["state"] == "wire-bound"
+    assert rep["dominant"] == "wire_ack"
+
+
+def test_classify_sum_bound():
+    w = {n: _rec(push=100_000, sum_us=90_000, pull=10_000)
+         for n in ("3", "4")}
+    rep = insight.classify(w)
+    assert rep["state"] == "sum-bound"
+    assert rep["dominant"] == "server_sum"
+
+
+def test_classify_straggler_skewed_outranks_dominance():
+    """A paced rank's inflated push wall flags skew even though the
+    fleet's dominant stage is (necessarily) wire_ack."""
+    w = {"3": _rec(push=8_000, sum_us=1_000, pull=2_000),
+         "4": _rec(push=900_000, sum_us=1_000, pull=2_000)}
+    rep = insight.classify(w)
+    assert rep["state"] == "straggler-skewed"
+    assert rep["stragglers"] == ["4"]
+
+
+def test_classify_retry_degraded_outranks_everything():
+    w = {"3": _rec(push=8_000, sum_us=1_000, retries=0),
+         "4": _rec(push=900_000, sum_us=1_000, retries=3)}
+    rep = insight.classify(w)
+    assert rep["state"] == "retry-degraded"
+
+
+def test_classify_healthy_when_nothing_dominates():
+    w = {n: _rec(queue=20_000, comp=20_000, push=45_000, sum_us=22_000,
+                 pull=20_000, dec=20_000) for n in ("3", "4")}
+    rep = insight.classify(w)
+    assert rep["state"] == "healthy"
+
+
+def test_classify_sub_floor_skew_stays_quiet():
+    """Loopback microsecond skew is noise, not a straggler (absolute
+    floor, mirroring monitor.top)."""
+    w = {"3": _rec(parts=4, push=200), "4": _rec(parts=4, push=3_000)}
+    rep = insight.classify(w)
+    assert rep["state"] != "straggler-skewed"
+
+
+def test_classify_idle_fleet():
+    rep = insight.classify({})
+    assert rep["state"] == "healthy" and rep["dominant"] == "idle"
+
+
+def test_dominant_stage_and_breakdown():
+    rec = _rec(queue=10, comp=20, push=100, sum_us=60, pull=30, dec=5)
+    bd = insight.stage_breakdown(rec)
+    assert bd["wire_ack"] == 40 and bd["server_sum"] == 60
+    stage, share = insight.dominant_stage(rec)
+    assert stage == "server_sum"
+    assert share == pytest.approx(60 / 165)
+
+
+def test_hints_name_the_knob():
+    # wire-bound, unfused small messages -> fusion knob by name
+    fleet = insight.merge_recs(
+        [_rec(parts=4, push=100_000, sum_us=5_000, wire_msgs=64)] * 2)
+    hs = insight.hints("wire-bound", fleet)
+    assert any("BYTEPS_FUSION_BYTES" in h for h in hs)
+    # sum-bound -> engine threads
+    hs = insight.hints("sum-bound", fleet)
+    assert any("BYTEPS_SERVER_ENGINE_THREAD" in h for h in hs)
+    # queue-dominant rides along regardless of state
+    fleet_q = insight.merge_recs([_rec(queue=500_000, push=100_000)])
+    hs = insight.hints("healthy", fleet_q)
+    assert any("BYTEPS_SCHEDULING_CREDIT" in h for h in hs)
+
+
+def test_regressions_need_baseline_and_blowout():
+    fleet = {
+        "3": {"role": 2, "updates": 10, "ewma_wall_us": 10_000.0,
+              "last": _rec(push=50_000)},          # 5x the baseline
+        "4": {"role": 2, "updates": 10, "ewma_wall_us": 10_000.0,
+              "last": _rec(push=11_000)},          # within noise
+        "5": {"role": 2, "updates": 1, "ewma_wall_us": 1.0,
+              "last": _rec(push=50_000)},          # baseline too young
+    }
+    assert insight.regressions(fleet) == ["3"]
+
+
+def test_analyze_full_snapshot_shape():
+    """analyze() over a scheduler-shaped snapshot: state + hints +
+    regressions + the rounds the fleet table holds."""
+    snap = {
+        "on": True, "role": 0, "node_id": 0,
+        "last": None, "rounds": [],
+        "fleet": {
+            "3": {"role": 2, "updates": 5, "ewma_wall_us": 100_000.0,
+                  "last": _rec(push=100_000, sum_us=5_000,
+                               wire_msgs=64)},
+            "4": {"role": 2, "updates": 5, "ewma_wall_us": 100_000.0,
+                  "last": _rec(push=100_000, sum_us=5_000,
+                               wire_msgs=64)},
+            "1": {"role": 1, "updates": 5, "ewma_wall_us": 5_000.0,
+                  "last": _rec(sum_us=5_000)},  # server: not a worker
+        },
+        "fleet_rounds": {"10": {"3": _rec(), "4": _rec()}},
+    }
+    rep = insight.analyze(snap)
+    assert rep["state"] == "wire-bound"
+    assert not rep["local_only"]
+    assert sorted(rep["workers"]) == ["3", "4"]
+    assert rep["rounds_seen"] == [10]
+    assert rep["hints"]
+
+
+def test_analyze_falls_back_to_local_ring():
+    snap = {"on": True, "role": 2, "node_id": 3,
+            "last": _rec(push=100_000, sum_us=80_000), "rounds": [],
+            "fleet": {}, "fleet_rounds": {}}
+    rep = insight.analyze(snap)
+    assert rep["local_only"]
+    assert rep["state"] == "sum-bound"

@@ -242,3 +242,58 @@ def test_step_window_enforced_in_core(tmp_path):
     with open(path) as f:
         names = [e["name"] for e in json.load(f)["traceEvents"]]
     assert names == ["before", "inside"]
+
+
+def _qspan(name, pid, key, ts, dur, wire, raw, peer=-1, req=-1,
+           round_=-1):
+    e = _span(name, pid, key, ts, dur, peer, req, round_)
+    e["args"]["wire_bytes"] = wire
+    e["args"]["raw_bytes"] = raw
+    return e
+
+
+def test_critical_path_quant_stages_and_byte_labels():
+    """ISSUE 7 satellite: qencode/qdecode are first-class stages, and
+    push spans' wire/raw byte labels aggregate into the per-worker
+    quantized-freight summary."""
+    worker = _dump(2, 3, 0, [
+        _span("qencode", 3, 7, ts=0, dur=9, round_=0),
+        _qspan("push", 3, 7, ts=10, dur=100, wire=1100, raw=4096,
+               peer=1, req=42, round_=0),
+        _span("pull", 3, 7, ts=120, dur=50, peer=1, req=43, round_=0),
+        _span("qdecode", 3, 7, ts=171, dur=6, round_=0),
+    ], worker_rank=0)
+    report = critical_path([worker])
+    fleet = report["fleet_stages_us"]
+    assert fleet["qencode"] == 9
+    assert fleet["qdecode"] == 6
+    wb = report["per_worker"]["worker 0 (node 3)"]
+    assert wb["push_wire_bytes"] == 1100
+    assert wb["push_raw_bytes"] == 4096
+    # Spans without byte labels (pre-quant dumps) keep working.
+    plain = _dump(2, 4, 0, [
+        _span("push", 4, 8, ts=0, dur=10, peer=1, req=1, round_=0),
+    ], worker_rank=1)
+    report = critical_path([plain])
+    wb = report["per_worker"]["worker 1 (node 4)"]
+    assert wb["push_wire_bytes"] == 0 and wb["push_raw_bytes"] == 0
+
+
+def test_pid_named_flight_dump_gets_pid_label():
+    """ISSUE 7 satellite: a pre-topology dump (node_id -1) is labelled
+    by its pid in the merged view — attributable, not 'node -1'."""
+    d = {"meta": {"ring": "flight", "role": 2, "node_id": -1,
+                  "worker_rank": -1, "pid": 4242,
+                  "clock_offset_us": 0, "clock_rtt_us": -1,
+                  "events_total": 1, "dropped": 0, "reason": "fatal"},
+         "traceEvents": [
+             {"name": "REQ_FAILED", "ph": "i", "s": "t", "pid": 0,
+              "tid": 1, "ts": 5, "args": {"key": 1}}]}
+    merged = merge_dumps([d])
+    labels = {e["args"]["name"] for e in merged["traceEvents"]
+              if e.get("ph") == "M"}
+    assert labels == {"worker (pid 4242)"}
+    # Distinct synthetic negative pids keep two anonymous ranks apart.
+    merged = merge_dumps([d, json.loads(json.dumps(d))])
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert len(pids) == 2

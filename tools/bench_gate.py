@@ -1,0 +1,269 @@
+#!/usr/bin/env python3
+"""Machine-readable bench regression gate (ISSUE 7 satellite).
+
+The repo accumulates one ``BENCH_<family>_rNN.json`` artifact per bench
+per PR round. This tool compares each family's NEWEST round against the
+PRIOR one on every shared numeric metric whose direction is known
+(throughput-like up is good, latency/overhead-like down is good),
+prints a pass/fail table, and exits nonzero on any regression past the
+threshold — the gate a CI job (or the next PR's author) runs before
+trusting a new artifact.
+
+**Environment-variance caveat** (recorded after PR 6, where float32
+scaling points ran 1.7-2.6x below the prior round ENVIRONMENTALLY and
+A/B'd identical on the unchanged tree): on a shared host, absolute
+steps/s swing far more between sessions than most code changes move
+them. Treat a FAIL here as "re-measure A/B on the unchanged tree
+first", not as proof of a code regression — only a paired A/B on one
+session is evidence. The default threshold is deliberately loose for
+the same reason.
+
+Modes::
+
+    python tools/bench_gate.py                  # gate every family
+    python tools/bench_gate.py --family trace   # one family
+    python tools/bench_gate.py --check-format   # schema-only: every
+        in-tree BENCH_*.json must parse as a non-empty JSON object
+        (wired into tier-1 so malformed artifacts fail fast, without
+        running any fleet)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_FAMILY_RE = re.compile(r"BENCH_(?P<name>.+)_r(?P<round>\d+)\.json$")
+_CORE_RE = re.compile(r"BENCH_r(?P<round>\d+)\.json$")
+
+# Metric direction by name token. A metric matching neither list is
+# compared but only reported (status "info") — gating on a metric whose
+# good direction is unknown would turn byte counts into failures.
+_HIGHER_BETTER = ("steps_per_s", "per_s", "per_sec", "gbps", "speedup",
+                  "throughput", "mfu", "examples", "ips", "balanced")
+_LOWER_BETTER = ("overhead_pct", "_us", "_ms", "seconds", "latency",
+                 "stall")
+
+
+def find_bench_files(repo: str = REPO) -> List[str]:
+    return sorted(glob.glob(os.path.join(repo, "BENCH_*.json")))
+
+
+def family_of(path: str) -> Optional[Tuple[str, int]]:
+    """(family, round) for a rounded artifact; None for un-rounded ones
+    (e.g. BENCH_fusion.json), which have no prior to gate against."""
+    base = os.path.basename(path)
+    m = _FAMILY_RE.match(base)
+    if m:
+        return m.group("name"), int(m.group("round"))
+    m = _CORE_RE.match(base)
+    if m:
+        return "core", int(m.group("round"))
+    return None
+
+
+def families(repo: str = REPO) -> Dict[str, Dict[int, str]]:
+    out: Dict[str, Dict[int, str]] = {}
+    for p in find_bench_files(repo):
+        fam = family_of(p)
+        if fam:
+            out.setdefault(fam[0], {})[fam[1]] = p
+    return out
+
+
+def flatten(doc, prefix: str = "") -> Dict[str, float]:
+    """Dotted-path -> numeric leaf. Lists index by position; strings and
+    bools (bool is reported via 'balanced'-style ints upstream) skipped."""
+    out: Dict[str, float] = {}
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            out.update(flatten(v, f"{prefix}{k}."))
+    elif isinstance(doc, list):
+        for i, v in enumerate(doc):
+            out.update(flatten(v, f"{prefix}{i}."))
+    elif isinstance(doc, (int, float)) and not isinstance(doc, bool):
+        if isinstance(doc, float) and not math.isfinite(doc):
+            return out
+        out[prefix[:-1]] = float(doc)
+    return out
+
+
+def direction(metric: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 unknown (info only)."""
+    name = metric.lower()
+    for tok in _HIGHER_BETTER:
+        if tok in name:
+            return 1
+    for tok in _LOWER_BETTER:
+        if tok in name:
+            return -1
+    return 0
+
+
+def compare(prev: dict, new: dict, threshold: float = 0.15) -> List[dict]:
+    """Per-metric rows: {metric, prev, new, change_pct, direction,
+    status} with status PASS / FAIL / info. Only metrics present in
+    BOTH rounds are gated — artifact shapes evolve between PRs."""
+    rows: List[dict] = []
+    fp, fn = flatten(prev), flatten(new)
+    for metric in sorted(set(fp) & set(fn)):
+        p, n = fp[metric], fn[metric]
+        d = direction(metric)
+        change = (n - p) / abs(p) if p else (0.0 if n == p else math.inf)
+        if d == 0:
+            status = "info"
+        elif d > 0:
+            status = "FAIL" if change < -threshold else "PASS"
+        else:
+            status = "FAIL" if change > threshold else "PASS"
+        rows.append({"metric": metric, "prev": p, "new": n,
+                     "change_pct": round(change * 100, 2)
+                     if math.isfinite(change) else None,
+                     "direction": {1: "up", -1: "down", 0: "?"}[d],
+                     "status": status})
+    return rows
+
+
+def gate_family(name: str, rounds: Dict[int, str],
+                threshold: float) -> Optional[dict]:
+    """Gate one family's newest round vs its prior; None with fewer
+    than two rounds on disk."""
+    if len(rounds) < 2:
+        return None
+    newest, prior = sorted(rounds)[-1], sorted(rounds)[-2]
+    with open(rounds[prior]) as f:
+        prev = json.load(f)
+    with open(rounds[newest]) as f:
+        new = json.load(f)
+    rows = compare(prev, new, threshold)
+    return {
+        "family": name,
+        "prev_round": prior, "new_round": newest,
+        "prev_file": os.path.basename(rounds[prior]),
+        "new_file": os.path.basename(rounds[newest]),
+        "rows": rows,
+        "failures": [r for r in rows if r["status"] == "FAIL"],
+    }
+
+
+def check_format(repo: str = REPO) -> List[str]:
+    """Schema-only validation of every in-tree BENCH artifact: must
+    parse as JSON and be a non-empty object. Returns violations."""
+    bad = []
+    for p in find_bench_files(repo):
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            bad.append(f"{os.path.basename(p)}: unparseable ({e})")
+            continue
+        if not isinstance(doc, dict) or not doc:
+            bad.append(f"{os.path.basename(p)}: not a non-empty JSON "
+                       "object")
+        elif not flatten(doc):
+            bad.append(f"{os.path.basename(p)}: no numeric metrics at "
+                       "all")
+    return bad
+
+
+def _print_table(report: dict, verbose: bool) -> None:
+    fails = report["failures"]
+    head = (f"{report['family']:<14} r{report['prev_round']:02d} -> "
+            f"r{report['new_round']:02d}  "
+            f"{'FAIL' if fails else 'PASS'}  "
+            f"({len(report['rows'])} shared metric(s), "
+            f"{len(fails)} regression(s))")
+    print(head)
+    shown = report["rows"] if verbose else fails
+    for r in shown:
+        ch = ("" if r["change_pct"] is None
+              else f"{r['change_pct']:+.1f}%")
+        print(f"  {r['status']:<4} {r['metric']:<52} "
+              f"{r['prev']:>12.4g} -> {r['new']:>12.4g}  {ch:>8} "
+              f"(good: {r['direction']})")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/bench_gate.py",
+        description="compare each BENCH_*_rNN.json family's newest "
+                    "round against the prior; exit nonzero on "
+                    "regression past the threshold")
+    p.add_argument("--repo", default=REPO)
+    p.add_argument("--family", default="",
+                   help="gate only this family (e.g. 'trace', "
+                        "'scaling', 'core')")
+    p.add_argument("--threshold", type=float, default=0.15,
+                   help="relative regression allowance (default 0.15 — "
+                        "deliberately loose; see the env-variance "
+                        "caveat in the module docstring)")
+    p.add_argument("--check-format", action="store_true",
+                   help="schema-only validation of every in-tree BENCH "
+                        "artifact (no comparison, no fleet)")
+    p.add_argument("--verbose", action="store_true",
+                   help="print every compared metric, not only "
+                        "failures")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    args = p.parse_args(argv)
+
+    if args.check_format:
+        bad = check_format(args.repo)
+        if args.json:
+            print(json.dumps({"mode": "check-format", "violations": bad}))
+        elif bad:
+            print("bench_gate --check-format: FAIL", file=sys.stderr)
+            for b in bad:
+                print(f"  {b}", file=sys.stderr)
+        else:
+            n = len(find_bench_files(args.repo))
+            print(f"bench_gate --check-format: OK ({n} artifact(s))")
+        return 1 if bad else 0
+
+    fams = families(args.repo)
+    if args.family:
+        if args.family not in fams:
+            print(f"unknown family {args.family!r}; have "
+                  f"{sorted(fams)}", file=sys.stderr)
+            return 2
+        fams = {args.family: fams[args.family]}
+    reports = []
+    for name in sorted(fams):
+        rep = gate_family(name, fams[name], args.threshold)
+        if rep:
+            reports.append(rep)
+        elif args.family and not args.json:
+            print(f"{name}: only round "
+                  f"r{sorted(fams[name])[-1]:02d} on disk — nothing "
+                  "to gate against")
+    any_fail = any(r["failures"] for r in reports)
+    if args.json:
+        print(json.dumps({"threshold": args.threshold,
+                          "families": reports,
+                          "regressed": any_fail}))
+    else:
+        for rep in reports:
+            _print_table(rep, args.verbose)
+        if any_fail:
+            print("\nbench_gate: REGRESSION — before trusting this, "
+                  "re-run the failing bench A/B on the UNCHANGED tree: "
+                  "on a shared host, environmental drift between "
+                  "sessions regularly exceeds this threshold "
+                  "(see BENCH_scaling_r06.json's in-artifact caveat).",
+                  file=sys.stderr)
+        else:
+            print(f"bench_gate: PASS ({len(reports)} family(ies) "
+                  f"gated at {args.threshold * 100:.0f}%)")
+    return 1 if any_fail else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
