@@ -224,6 +224,14 @@ def main() -> None:
                         "interleaved paired-ratio methodology as "
                         "--trace-overhead. Writes --out "
                         "(BENCH_insight_r07.json)")
+    p.add_argument("--elastic", action="store_true",
+                   help="ISSUE 8 artifact: membership epoch-change "
+                        "pause time on a live 2wx2s comm-round fleet — "
+                        "grow (one DMLC_JOIN joiner) and shrink (one "
+                        "graceful leave via the retire-file protocol), "
+                        "both read from the scheduler's "
+                        "bps_epoch_change_ms gauge. Writes --out "
+                        "(BENCH_elastic_r08.json)")
     p.add_argument("--trace-overhead", action="store_true",
                    help="ISSUE 5 acceptance artifact: comm-only "
                         "small-tensor rounds over a real 2wx2s PS fleet "
@@ -242,10 +250,14 @@ def main() -> None:
     args = p.parse_args()
     if args.role == "trace_overhead_worker":
         return _trace_overhead_worker(args)
+    if args.role == "elastic_member_worker":
+        return _elastic_member_worker(args)
     if args.trace_overhead:
         return bench_trace_overhead(args)
     if args.insight_overhead:
         return bench_insight_overhead(args)
+    if args.elastic:
+        return bench_elastic(args)
     if args.sweep:
         args.mfu = True
         if args.repeats is None:
@@ -811,6 +823,190 @@ def bench_insight_overhead(args) -> None:
     if args.out:
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)
+        print(json.dumps({"artifact": args.out}))
+
+
+def _elastic_member_worker(args) -> None:
+    """Fleet-member loop for bench_elastic: comm-only constant-data
+    rounds (mean == 1.0 under any contributor set, so a joiner needs no
+    phase coordination), a unanimous stop-file vote, and a graceful
+    leave when this rank's retire file appears."""
+    import os
+    import time
+
+    import numpy as np
+
+    from byteps_tpu.core import Worker
+    from byteps_tpu.core.ffi import leave_requested
+
+    stop_file = os.environ.get("BPS_BENCH_STOP_FILE", "")
+    w = Worker.start()
+    n = 4096
+    tid = w.declare("eb", n, "float32", compression="")
+    vote = w.declare("eb_vote", 8, "float32", compression="")
+    rounds = 0
+    left = False
+    for _ in range(1 << 20):
+        arr = np.ones(n, np.float32)
+        h = w.push_pull(tid, arr, average=True)
+        ready = 1.0 if stop_file and os.path.exists(stop_file) else 0.0
+        varr = np.full(8, ready, np.float32)
+        hv = w.push_pull(vote, varr, average=True)
+        w.wait(h)
+        w.wait(hv)
+        assert arr[0] == 1.0, arr[0]
+        rounds += 1
+        if leave_requested():
+            w.leave()
+            left = True
+            break
+        if varr[0] >= 1.0:  # unanimous across the current fleet
+            break
+        time.sleep(0.02)
+    print(json.dumps({"rounds": rounds, "left": left,
+                      "epoch": w.epoch(),
+                      "workers": w.num_workers()}), flush=True)
+    w.shutdown()
+
+
+def bench_elastic(args) -> None:
+    """Membership epoch-change pause time (ISSUE 8 artifact): on a live
+    2wx2s comm-round fleet, grow by one DMLC_JOIN joiner and shrink by
+    one graceful leave, reading each change's request->RESUME wall from
+    the scheduler's bps_epoch_change_ms gauge (the grow number includes
+    the fleet-wide gate-ack cycle; the shrink commits ack-free)."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    import urllib.request
+
+    from byteps_tpu.monitor.metrics import parse_prometheus
+    from tools.shaped_fleet import free_port
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    td = tempfile.mkdtemp(prefix="bps_elastic_bench_")
+    stop_file = os.path.join(td, "stop")
+    port = free_port()
+    mport = free_port()
+    env = dict(os.environ)
+    env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "2",
+        "DMLC_NUM_SERVER": str(args.servers),
+        "BYTEPS_ELASTIC": "1",
+        "BYTEPS_MONITOR_ON": "1",
+        "BYTEPS_MONITOR_PORT": str(mport),
+        "PS_HEARTBEAT_INTERVAL": "0.5",
+        "PS_HEARTBEAT_TIMEOUT": "2",
+        "BPS_BENCH_STOP_FILE": stop_file,
+        "PYTHONPATH": repo,
+    })
+    procs = []
+    try:
+        for role, count in (("scheduler", 1), ("server", args.servers)):
+            for _ in range(count):
+                e = dict(env)
+                e["DMLC_ROLE"] = role
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "byteps_tpu.server"], env=e))
+
+        def spawn_worker(idx, join):
+            e = dict(env)
+            e["DMLC_ROLE"] = "worker"
+            e["DMLC_WORKER_ID"] = str(idx)
+            e["BYTEPS_RETIRE_FILE"] = os.path.join(td, f"retire.{idx}")
+            if join:
+                e["DMLC_JOIN"] = "1"
+            return subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--role", "elastic_member_worker"],
+                env=e, stdout=subprocess.PIPE, text=True)
+
+        workers = [spawn_worker(i, False) for i in range(2)]
+        procs += workers
+
+        def scrape():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mport}/metrics",
+                        timeout=2) as r:
+                    return parse_prometheus(r.read().decode())
+            except (OSError, ValueError):
+                return None
+
+        def gauge(m, name):
+            series = (m or {}).get(name)
+            return next(iter(series.values())) if series else None
+
+        def wait_gauge(name, val, timeout_s=120.0):
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                m = scrape()
+                if gauge(m, name) == val:
+                    return m
+                time.sleep(0.2)
+            raise SystemExit(f"timeout waiting for {name} == {val}")
+
+        wait_gauge("bps_fleet_workers", 2)
+        time.sleep(2.0)  # let steady-state rounds flow
+        t0 = time.time()
+        joiner = spawn_worker(2, True)
+        procs.append(joiner)
+        m = wait_gauge("bps_fleet_workers", 3)
+        grow_wall_s = time.time() - t0
+        grow_ms = gauge(m, "bps_epoch_change_ms")
+        time.sleep(2.0)
+        t0 = time.time()
+        with open(os.path.join(td, "retire.2"), "w") as f:
+            f.write("retire\n")
+        m = wait_gauge("bps_fleet_workers", 2)
+        shrink_wall_s = time.time() - t0
+        shrink_ms = gauge(m, "bps_epoch_change_ms")
+        with open(stop_file, "w") as f:
+            f.write("stop\n")
+        rounds = 0
+        for wp in workers + [joiner]:
+            out, _ = wp.communicate(timeout=120)
+            if wp.returncode != 0:
+                raise SystemExit(f"fleet member failed:\n{out}")
+            for ln in out.splitlines():
+                if ln.startswith("{"):
+                    rounds = max(rounds, json.loads(ln).get("rounds", 0))
+        for pr in procs[:1 + args.servers]:
+            pr.wait(timeout=60)
+    finally:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.kill()
+    doc = {
+        "what": ("elastic membership epoch-change pause time on a live "
+                 "2wx2s comm-round fleet (ISSUE 8): grow = one "
+                 "DMLC_JOIN joiner (request -> RESUME broadcast, the "
+                 "scheduler's bps_epoch_change_ms gauge — includes the "
+                 "fleet-wide drain-free gate-ack cycle), shrink = one "
+                 "graceful leave via the launcher retire-file protocol "
+                 "(ack-free commit). Observed wall = parent-side "
+                 "spawn/poll bound, dominated by process startup for "
+                 "the grow"),
+        "workers_initial": 2,
+        "servers": args.servers,
+        "summary": {
+            "grow_pause_ms": grow_ms,
+            "shrink_pause_ms": shrink_ms,
+            "grow_observed_wall_s": round(grow_wall_s, 3),
+            "shrink_observed_wall_s": round(shrink_wall_s, 3),
+            "rounds_completed_max": rounds,
+        },
+    }
+    print(json.dumps({"metric": "grow_pause_ms", "value": grow_ms,
+                      "unit": "ms"}))
+    print(json.dumps({"metric": "shrink_pause_ms", "value": shrink_ms,
+                      "unit": "ms"}))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
         print(json.dumps({"artifact": args.out}))
 
 

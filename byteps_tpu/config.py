@@ -170,6 +170,26 @@ class Config:
     #   shard instead of joining fleet formation (set by the supervisor
     #   when respawning a dead server role)
 
+    # --- elastic worker membership (ISSUE 8; docs/elasticity.md) -----------
+    elastic: bool = False                 # BYTEPS_ELASTIC
+    #   arm join / graceful-leave / worker-death-shrink handling: the
+    #   worker set becomes an epoch-versioned quantity — a new worker
+    #   (DMLC_JOIN) enters at the next round boundary, a leaver drains
+    #   and departs, and a dead worker (heartbeat timeout) shrinks the
+    #   fleet to N-1 via server-side rollback instead of the fail-stop
+    #   SHUTDOWN. 0 (default) keeps the PR 3 fail-stop contract byte
+    #   for byte. Requires the retry layer (BYTEPS_RETRY_MAX > 0).
+    #   Memory while armed: servers retain each in-flight round's
+    #   per-sender decoded contributions (freed at round completion)
+    elastic_timeout_ms: int = 30000       # BYTEPS_ELASTIC_TIMEOUT_MS
+    #   fail-stop fallback window: a membership change that cannot
+    #   commit (a worker never acks the join gate) falls back to the
+    #   failure SHUTDOWN after this long
+    join_fleet: bool = False              # DMLC_JOIN
+    #   worker-process only: join a RUNNING fleet instead of taking part
+    #   in formation (set by the launcher's elastic scale-up / a
+    #   supervisor respawning a dead worker as a fresh joiner)
+
     # --- chaos injection (deterministic fault harness; BYTEPS_CHAOS_*) -----
     chaos_seed: int = 0                   # BYTEPS_CHAOS_SEED
     chaos_drop: float = 0.0               # BYTEPS_CHAOS_DROP
@@ -410,6 +430,34 @@ class Config:
                     f"DMLC_RECOVER_RANK={self.recover_rank} out of range: "
                     f"the fleet has {self.num_server} server rank(s) "
                     f"(valid: 0..{max(self.num_server - 1, 0)})")
+        if self.elastic and self.retry_max == 0:
+            raise ValueError(
+                "BYTEPS_ELASTIC requires the retry layer "
+                "(BYTEPS_RETRY_MAX > 0): membership changes leave "
+                "rounds mid-flight across the commit, and only the "
+                "retry/dedup machinery makes their completion exact")
+        if self.elastic_timeout_ms < 1000:
+            raise ValueError(
+                "BYTEPS_ELASTIC_TIMEOUT_MS must be >= 1000 (the "
+                "fail-stop fallback window for a membership change "
+                "that cannot commit)")
+        if self.join_fleet:
+            if not self.elastic:
+                raise ValueError(
+                    "DMLC_JOIN is set but BYTEPS_ELASTIC is off — the "
+                    "scheduler would ignore the join request and this "
+                    "process would time out at formation")
+            if self.role != "worker":
+                raise ValueError(
+                    "DMLC_JOIN is a worker-process knob (a new worker "
+                    f"joining a running fleet); role is {self.role!r}")
+        if self.elastic and self.heartbeat_interval_s <= 0:
+            import warnings
+            warnings.warn(
+                "BYTEPS_ELASTIC with heartbeats disabled "
+                "(PS_HEARTBEAT_INTERVAL <= 0): planned joins/leaves "
+                "work, but a worker DEATH can never be detected, so "
+                "the death-shrink path is unreachable", stacklevel=2)
         if self.effective_recovery_timeout_ms > 0 and self.enable_async:
             # Async mode keeps the authoritative accumulator SERVER-side;
             # a dead server's param state is not reconstructible from
@@ -506,6 +554,9 @@ def load_config() -> Config:
         recovery_timeout_ms=_env_int("BYTEPS_RECOVERY_TIMEOUT_MS", 60000),
         recover_rank=(int(os.environ["DMLC_RECOVER_RANK"])
                       if os.environ.get("DMLC_RECOVER_RANK") else None),
+        elastic=_env_bool("BYTEPS_ELASTIC"),
+        elastic_timeout_ms=_env_int("BYTEPS_ELASTIC_TIMEOUT_MS", 30000),
+        join_fleet=_env_bool("DMLC_JOIN"),
         chaos_seed=_env_int("BYTEPS_CHAOS_SEED", 0),
         chaos_drop=float(os.environ.get("BYTEPS_CHAOS_DROP", "0") or 0),
         chaos_dup=float(os.environ.get("BYTEPS_CHAOS_DUP", "0") or 0),

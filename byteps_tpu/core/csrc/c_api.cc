@@ -17,6 +17,7 @@
 #include "compressor.h"
 #include "cpu_reducer.h"
 #include "debug.h"
+#include "elastic.h"
 #include "kv.h"
 #include "logging.h"
 #include "metrics.h"
@@ -142,6 +143,13 @@ int bps_init(int role) {
     handler = [gl](Message&& m, int fd) {
       gl->server->Handle(std::move(m), fd);
     };
+    // Elastic worker membership (ISSUE 8): membership epochs land here
+    // — a join pushes a new contributor roster, a removal rolls the
+    // in-flight rounds back onto the survivors.
+    gl->po->SetFleetResizeCallback(
+        [gl](int kind, int affected, int64_t jr, int64_t jb) {
+          gl->server->OnFleetResize(kind, affected, jr, jb);
+        });
   } else if (gl->role == ROLE_WORKER) {
     gl->kv = std::make_unique<KVWorker>(
         gl->po.get(), EnvInt("BYTEPS_WORKER_CALLBACK_THREADS", 4));
@@ -171,6 +179,17 @@ int bps_init(int role) {
     gl->po->SetPeerRecoveredCallback([gl](int node_id) {
       gl->worker->OnServerRecovered(node_id);
     });
+    // Elastic worker membership (ISSUE 8): a JOIN gates new rounds and
+    // acks the scheduler with this worker's counters; the RESUME syncs
+    // counters to the activation round and lifts the gate.
+    gl->po->SetFleetPauseCallback([gl](int kind) {
+      gl->worker->OnFleetPause(kind);
+    });
+    gl->po->SetFleetResumeCallback(
+        [gl](int kind, int affected, int64_t jr, int64_t jb) {
+          (void)affected;
+          gl->worker->OnFleetResume(kind, jr, jb);
+        });
     // The worker pipeline exists BEFORE the postoffice starts (same
     // reasoning as the server's engine threads above): recovery
     // callbacks fire on van threads and must always find a live
@@ -188,6 +207,14 @@ int bps_init(int role) {
   }
 
   int id = gl->po->Start(gl->role, uri, port, nw, ns, std::move(handler));
+  // Elastic joiner (DMLC_JOIN): the scheduler's direct ADDRBOOK carried
+  // the round boundary this rank enters at — every tensor declared from
+  // here starts its counters there, so the first push lands exactly in
+  // the first round the new roster expects this rank in.
+  if (gl->role == ROLE_WORKER && EnvBool("DMLC_JOIN")) {
+    gl->worker->SyncRounds(gl->po->join_round(),
+                           gl->po->join_bcast_round());
+  }
   // Fleet tracing (ISSUE 5): identity for this rank's dump metadata,
   // plus the trace-health series pre-registered so every /metrics page
   // serves them from zero (monitor.top's TRACE-DROPPING flag).
@@ -235,6 +262,28 @@ int bps_my_id() { return g()->po->my_id(); }
 int bps_worker_rank() { return g()->po->my_worker_rank(); }
 int bps_num_workers() { return g()->po->num_workers(); }
 int bps_num_servers() { return g()->po->num_servers(); }
+
+// Fleet membership epoch (bumped per server recovery AND per worker
+// join/leave/shrink — ISSUE 4 + ISSUE 8). Live: num_workers above also
+// tracks elastic membership changes.
+long long bps_epoch() {
+  Global* gl = g();
+  return gl->po ? gl->po->epoch() : 0;
+}
+
+// Graceful leave (ISSUE 8): drain this worker's in-flight requests,
+// tell the scheduler, and wait for the removal ack. After a 0 return
+// the process should call bps_finalize and exit — it is out of the
+// fleet's shutdown quorum and owes no goodbye. -1 = not a worker, the
+// scheduler never acked (elasticity off?), or requests still pending.
+int bps_leave() {
+  Global* gl = g();
+  if (!gl->inited || gl->role != ROLE_WORKER || !gl->kv) return -1;
+  // The caller should have waited its handles; this drains whatever
+  // bookkeeping is left so the LEAVE provably follows the last settle.
+  gl->kv->WaitAll();
+  return gl->po->RequestLeave() ? 0 : -1;
+}
 
 void bps_barrier(int group) { g()->po->Barrier(group); }
 
@@ -353,6 +402,122 @@ long long bps_quant_roundtrip(const void* src, long long n, int block,
     return -1;
   }
   return static_cast<long long>(enc.size());
+}
+
+// Elastic epoch-roster / rollback probe (ISSUE 8; no topology needed):
+// drives one RosterHistory + one key-slot contribution roster through a
+// `;`-separated script and writes the final state as JSON into `buf`
+// (same grow-the-buffer contract as bps_metrics_snapshot). Ops:
+//   live:1,2,3   install the initial roster (ids)
+//   join:5@8     id 5 joins, activating at round 8 (both round spaces)
+//   remove:2     id 2 leaves/dies: erased from every roster AND its
+//                retained slot contribution discarded (the rollback)
+//   push:3       id 3 contributes 4 floats of value 3 to the slot
+//   pull:3       id 3 pulled the slot's round
+//   seal / reset round-ready / slot-recycle bookkeeping
+//   round:8      the round number ready/served are evaluated against
+// Output: {"roster":[...],"pushers":[...],"pullers":[...],
+//          "ready":bool,"served":bool,"sum":[4 ints]} — `sum` is the
+// slot rebuilt from the SURVIVING contributions (ascending sender id),
+// i.e. exactly what the server's shrink rollback installs. Returns the
+// JSON length, or -1 on a malformed script.
+long long bps_elastic_probe(const char* script, char* buf,
+                            long long maxlen) {
+  if (!script) return -1;
+  RosterHistory roster;
+  ElasticSlot slot;
+  long long round = 0;
+  const std::string s(script);
+  auto parse_ids = [](const std::string& v) {
+    std::set<int> out;
+    size_t p = 0;
+    while (p < v.size()) {
+      size_t c = v.find(',', p);
+      if (c == std::string::npos) c = v.size();
+      out.insert(atoi(v.substr(p, c - p).c_str()));
+      p = c + 1;
+    }
+    return out;
+  };
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t end = s.find(';', pos);
+    if (end == std::string::npos) end = s.size();
+    const std::string tok = s.substr(pos, end - pos);
+    pos = end + 1;
+    if (tok.empty()) continue;
+    const size_t colon = tok.find(':');
+    const std::string op = tok.substr(0, colon);
+    const std::string val =
+        colon == std::string::npos ? "" : tok.substr(colon + 1);
+    if (op == "live") {
+      roster.Init(parse_ids(val));
+    } else if (op == "join") {
+      const size_t at = val.find('@');
+      const int id = atoi(val.substr(0, at).c_str());
+      const long long r =
+          at == std::string::npos ? 0 : atoll(val.substr(at + 1).c_str());
+      roster.Join(id, r, r);
+    } else if (op == "remove") {
+      const int id = atoi(val.c_str());
+      roster.Remove(id);
+      slot.Remove(id);
+    } else if (op == "push") {
+      const int id = atoi(val.c_str());
+      const float v[4] = {static_cast<float>(id), static_cast<float>(id),
+                          static_cast<float>(id), static_cast<float>(id)};
+      slot.Push(id, reinterpret_cast<const char*>(v), sizeof(v));
+    } else if (op == "pull") {
+      slot.Pull(atoi(val.c_str()));
+    } else if (op == "seal") {
+      slot.SealPushes();
+    } else if (op == "reset") {
+      slot.Reset();
+    } else if (op == "round") {
+      round = atoll(val.c_str());
+    } else {
+      return -1;
+    }
+  }
+  auto ro = roster.OfRound(round);
+  float sum[4] = {0, 0, 0, 0};
+  const bool have_sum = slot.RebuildSum(reinterpret_cast<char*>(sum),
+                                        sizeof(sum), BPS_FLOAT32);
+  std::string out = "{";
+  auto emit_set = [&out](const char* name, const std::set<int>& v) {
+    out += std::string("\"") + name + "\":[";
+    bool first = true;
+    for (int id : v) {
+      if (!first) out += ",";
+      first = false;
+      out += std::to_string(id);
+    }
+    out += "]";
+  };
+  emit_set("roster", *ro);
+  out += ",";
+  emit_set("pushers", slot.pushers());
+  out += ",";
+  emit_set("pullers", slot.pullers());
+  out += ",\"ready\":";
+  out += (!ro->empty() && slot.PushersMatch(*ro)) ? "true" : "false";
+  out += ",\"served\":";
+  out += (!ro->empty() && slot.PullersCover(*ro)) ? "true" : "false";
+  out += ",\"sum\":[";
+  if (have_sum) {
+    for (int i = 0; i < 4; ++i) {
+      if (i) out += ",";
+      out += std::to_string(static_cast<long long>(sum[i]));
+    }
+  }
+  out += "]}";
+  const long long need = static_cast<long long>(out.size());
+  if (buf && maxlen > 0) {
+    long long n = need < maxlen - 1 ? need : maxlen - 1;
+    memcpy(buf, out.data(), static_cast<size_t>(n));
+    buf[n] = '\0';
+  }
+  return need;
 }
 
 // Standalone CpuReducer throughput probe: repeatedly sum a src buffer

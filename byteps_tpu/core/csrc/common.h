@@ -104,6 +104,43 @@ enum Command : int32_t {
                              // round, payload = the unscaled aggregate)
                              // so pulls parked mid-round can be served
                              // from the authoritative worker replica.
+  // Elastic worker membership (ISSUE 8): the worker set is an
+  // epoch-versioned quantity — joins, graceful leaves, and (with
+  // BYTEPS_ELASTIC=1) unplanned worker deaths change the fleet size
+  // without a restart. All of these are CONTROL-PLANE: never
+  // chaos-injected, never retried — losing one would strand a
+  // membership change exactly like a lost heartbeat fakes a death.
+  CMD_JOIN_REQUEST = 26,     // new worker -> scheduler: join the running
+                             // fleet (payload = NodeInfo; the scheduler
+                             // answers with a direct CMD_ADDRBOOK whose
+                             // arg0 = the allocated never-reused id and
+                             // arg1 = (join_round << 32) | bcast_round —
+                             // the round boundary the joiner enters at).
+  CMD_LEAVE_REQUEST = 27,    // departing worker -> scheduler: graceful
+                             // leave, sent after the worker drained its
+                             // in-flight rounds (all handles settled).
+  CMD_LEAVE_ACK = 28,        // scheduler -> leaver: removal recorded;
+                             // the leaver may exit (no goodbye owed).
+  CMD_FLEET_PAUSE = 29,      // scheduler -> all: worker membership is
+                             // changing (arg0 = new epoch, version =
+                             // kind 0 join / 1 leave / 2 death, key =
+                             // affected node id, -1 for a join). For a
+                             // JOIN, workers gate new rounds and answer
+                             // CMD_FLEET_PAUSE_ACK with their round
+                             // counters; leaves/shrinks need no gate
+                             // (the departed rank is in no incomplete
+                             // round once the server rolls it back).
+  CMD_FLEET_PAUSE_ACK = 30,  // worker -> scheduler: rounds gated;
+                             // arg0 = max tensor round counter, arg1 =
+                             // max broadcast round counter (the
+                             // scheduler's join_round is the fleet max).
+  CMD_FLEET_RESUME = 31,     // scheduler -> all: the membership change
+                             // is committed (arg0 = epoch, version =
+                             // kind, key = affected node id, arg1 =
+                             // (join_round << 32) | bcast_round for a
+                             // join, payload = the full new NodeInfo
+                             // address book). Servers re-roster; workers
+                             // sync counters (join) and lift the gate.
   CMD_HEARTBEAT_ACK = 25,    // scheduler -> node: echo of a heartbeat
                              // (arg0 = the sender's original send
                              // timestamp in steady-clock us, arg1 = the

@@ -49,11 +49,25 @@ int64_t RecoveryTimeoutMs() {
 
 bool RecoveryEnabled() { return RecoveryTimeoutMs() > 0 && RetryEnabled(); }
 
+// Elastic worker membership (ISSUE 8): BYTEPS_ELASTIC=1 arms join /
+// graceful-leave / worker-death-shrink handling. The C side reads the
+// env directly (config.py validates it needs the retry layer); with it
+// off, a dead worker keeps the PR 3 fail-stop broadcast byte for byte.
+bool ElasticEnabled() {
+  static const bool on = EnvLong("BYTEPS_ELASTIC", 0) > 0;
+  return on;
+}
+
+int64_t ElasticTimeoutMs() {
+  static const int64_t ms = EnvLong("BYTEPS_ELASTIC_TIMEOUT_MS", 30000);
+  return ms;
+}
+
 int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
                       int num_workers, int num_servers,
                       AppHandler app_handler) {
   role_ = role;
-  num_workers_ = num_workers;
+  num_workers_.store(num_workers);
   num_servers_ = num_servers;
   app_handler_ = std::move(app_handler);
   van_ = std::make_unique<Van>(
@@ -218,6 +232,18 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
       BPS_LOG(WARNING) << "server: registering as hot replacement for "
                           "server rank " << h.arg0;
     }
+    // Elastic joiner (ISSUE 8): DMLC_JOIN marks a worker joining a
+    // RUNNING fleet. The scheduler allocates a fresh never-reused rank,
+    // gates the fleet's new rounds, and answers with a direct ADDRBOOK
+    // (arg1 = the round boundary this rank enters at) — no fleet
+    // re-formation.
+    const char* jn = getenv("DMLC_JOIN");
+    if (role == ROLE_WORKER && jn && *jn && strcmp(jn, "0") != 0) {
+      h.cmd = CMD_JOIN_REQUEST;
+      BPS_LOG(WARNING) << "worker: joining a running fleet "
+                          "(DMLC_JOIN set) — awaiting the scheduler's "
+                          "membership epoch";
+    }
     van_->Send(fd, h, &me, sizeof(me));
     // Wait for the address book (same formation bound as the scheduler).
     std::unique_lock<std::mutex> lk(mu_);
@@ -270,6 +296,15 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
     Metrics::Get().Counter("bps_recoveries_total");
     Metrics::Get().Gauge("bps_membership_epoch");
     Metrics::Get().Gauge("bps_recovering");
+    // Elastic worker membership (ISSUE 8): fleet-size series live on
+    // the scheduler from zero — monitor.top's fleet header and the
+    // elastic tests read them.
+    Metrics::Get().Counter("bps_worker_joins_total");
+    Metrics::Get().Counter("bps_worker_leaves_total");
+    Metrics::Get().Gauge("bps_fleet_workers");
+    Metrics::Get().Gauge("bps_fleet_resizing");
+    Metrics::Get().Gauge("bps_epoch_change_ms");
+    BPS_METRIC_GAUGE_SET("bps_fleet_workers", num_workers_.load());
     monitor_thread_ = std::thread([this, interval] {
       int64_t next_check_ms =
           NowMs() + static_cast<int64_t>(interval * 1000);
@@ -287,6 +322,18 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
                 std::to_string(RecoveryTimeoutMs()) + " ms");
             return;
           }
+          // Membership-change fallback: a join whose gate acks never
+          // complete (a worker wedged or died mid-change) falls back to
+          // the fail-stop broadcast, so elasticity strictly improves on
+          // the PR 3 contract instead of trading it for a hang.
+          if (member_active_ && NowMs() > member_deadline_ms_) {
+            BroadcastFailureLocked(
+                "worker membership change (kind " +
+                std::to_string(member_op_.kind) + ") did not commit "
+                "within BYTEPS_ELASTIC_TIMEOUT_MS=" +
+                std::to_string(ElasticTimeoutMs()) + " ms");
+            return;
+          }
         }
         if (NowMs() < next_check_ms) continue;
         next_check_ms = NowMs() + static_cast<int64_t>(interval * 1000);
@@ -298,9 +345,44 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
         bool recoverable = RecoveryEnabled() && dead.size() == 1 &&
                            dead[0] >= ServerId(0) &&
                            dead[0] <= num_servers_;
+        // Shrinkable (ISSUE 8): exactly one dead node, it is a WORKER,
+        // elasticity is armed, and at least one worker survives. The
+        // fleet shrinks to N-1 instead of fail-stopping — the server
+        // rollback discards the dead rank's partial contributions and
+        // every later round is an exact mean over the survivors.
+        bool shrinkable = ElasticEnabled() && RetryEnabled() &&
+                          dead.size() == 1 && dead[0] > num_servers_ &&
+                          num_workers_.load() > 1;
         std::lock_guard<std::mutex> lk(mu_);
         if (recoverable) {
           if (recovering_node_ < 0) StartRecoveryLocked(dead[0]);
+          continue;
+        }
+        if (shrinkable && recovering_node_ < 0) {
+          BPS_LOG(WARNING) << "scheduler: worker " << dead[0]
+                           << " missed heartbeats — elastic shrink to "
+                           << num_workers_.load() - 1 << " worker(s) "
+                              "instead of fail-stop (BYTEPS_ELASTIC)";
+          last_heartbeat_ms_.erase(dead[0]);
+          departed_.insert(dead[0]);
+          MemberOp op;
+          op.kind = 2;
+          op.node_id = dead[0];
+          member_queue_.push_back(std::move(op));
+          if (!member_active_) {
+            MemberOp next = std::move(member_queue_.front());
+            member_queue_.pop_front();
+            StartMemberOpLocked(std::move(next));
+          } else if (member_op_.kind == 0 &&
+                     pause_acks_pending_.erase(dead[0]) > 0 &&
+                     pause_acks_pending_.empty()) {
+            // Supervisor-respawn-ahead-of-detection: a joiner arrived
+            // while the dead rank was still counted, and its gate ack
+            // can never come. Commit the join without it — the queued
+            // death op right behind removes it from every roster (and
+            // rolls back its partial contributions).
+            CompleteMemberOpLocked();
+          }
           continue;
         }
         std::string ids;
@@ -368,9 +450,13 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
                        nodes_.size() * sizeof(NodeInfo));
           }
           addrbook_ready_ = true;
+          // Elastic rank allocation starts past the formation ranks:
+          // joined workers get fresh, never-reused ranks/ids.
+          next_worker_rank_ = next_worker;
           cv_.notify_all();
-          BPS_LOG(INFO) << "scheduler: topology complete (" << num_workers_
-                        << " workers, " << num_servers_ << " servers)";
+          BPS_LOG(INFO) << "scheduler: topology complete ("
+                        << num_workers_.load() << " workers, "
+                        << num_servers_ << " servers)";
         }
       } else {
         // Server side: a worker identifying itself on a fresh connection.
@@ -392,6 +478,20 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
       size_t n = msg.payload.size() / sizeof(NodeInfo);
       nodes_.resize(n);
       memcpy(nodes_.data(), msg.payload.data(), n * sizeof(NodeInfo));
+      // Fleet size from the book itself, not the env: a JOINER's
+      // DMLC_NUM_WORKER describes the formation-time fleet, and an
+      // elastic fleet's size is whatever the scheduler says it is.
+      int nw = 0;
+      for (const auto& node : nodes_) {
+        if (node.role == ROLE_WORKER) ++nw;
+      }
+      if (nw > 0) num_workers_.store(nw);
+      // Joiner activation boundary (CMD_JOIN_REQUEST answer): the round
+      // counters this rank's tensors start at. 0 on ordinary formation.
+      if (msg.head.arg1 != 0) {
+        join_round_.store(msg.head.arg1 >> 32);
+        join_bcast_.store(msg.head.arg1 & 0xffffffff);
+      }
       addrbook_ready_ = true;
       cv_.notify_all();
       break;
@@ -401,7 +501,7 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
       int group = static_cast<int>(msg.head.arg0);
       std::lock_guard<std::mutex> lk(mu_);
       int need = ((group & GROUP_SERVERS) ? num_servers_ : 0) +
-                 ((group & GROUP_WORKERS) ? num_workers_ : 0);
+                 ((group & GROUP_WORKERS) ? num_workers_.load() : 0);
       if (++barrier_counts_[group] == need) {
         barrier_counts_[group] = 0;
         MsgHeader h{};
@@ -551,10 +651,112 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
       }
       break;
     }
+    case CMD_JOIN_REQUEST: {
+      HandleJoinRequest(std::move(msg), fd);
+      break;
+    }
+    case CMD_LEAVE_REQUEST: {
+      HandleLeaveRequest(msg, fd);
+      break;
+    }
+    case CMD_LEAVE_ACK: {
+      // Scheduler recorded our departure: this rank is out of the
+      // fleet's quorum and may exit without a goodbye.
+      left_.store(true);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        leave_acked_ = true;
+      }
+      cv_.notify_all();
+      break;
+    }
+    case CMD_FLEET_PAUSE: {
+      // Worker membership is changing (arg0 = new epoch, version =
+      // kind, key = affected node id). For a JOIN every worker gates
+      // new rounds and answers with its round counters — in-flight
+      // rounds keep completing against the OLD roster, so the ack is
+      // drain-free. Leaves/shrinks carry no gate: the RESUME (and the
+      // server rollback) follows immediately.
+      int kind = msg.head.version;
+      epoch_.store(msg.head.arg0);
+      BPS_METRIC_GAUGE_SET("bps_membership_epoch", epoch_.load());
+      Trace::Get().Note("FLEET_PAUSE", msg.head.arg0,
+                        static_cast<int>(msg.head.key), -1, kind);
+      Trace::Get().FlightDumpAuto("fleet_pause");
+      BPS_LOG(WARNING) << "node " << my_id_ << ": epoch "
+                       << msg.head.arg0 << " FLEET_PAUSE — worker "
+                       << (kind == 0 ? "joining" :
+                           kind == 1 ? "leaving" : "death shrink");
+      if (role_ == ROLE_WORKER && kind == 0 && fleet_pause_cb_) {
+        fleet_pause_cb_(kind);
+      }
+      break;
+    }
+    case CMD_FLEET_PAUSE_ACK: {
+      // Scheduler: one worker's rounds are gated; its counters bound
+      // the join activation round. Last ack commits the change.
+      BPS_CHECK_EQ(role_, ROLE_SCHEDULER);
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!member_active_ || member_op_.kind != 0) break;
+      member_round_max_ = std::max(member_round_max_, msg.head.arg0);
+      member_bcast_max_ = std::max(member_bcast_max_, msg.head.arg1);
+      pause_acks_pending_.erase(msg.head.sender);
+      if (pause_acks_pending_.empty()) CompleteMemberOpLocked();
+      break;
+    }
+    case CMD_FLEET_RESUME: {
+      // The membership change committed: refresh the address book,
+      // recount the fleet, and hand the kind-specific work to the role
+      // layer (worker: sync counters + lift the gate; server: re-roster
+      // + roll back a removed rank's partial contributions).
+      int kind = msg.head.version;
+      int affected = static_cast<int>(msg.head.key);
+      int64_t jr = msg.head.arg1 >> 32;
+      int64_t jb = msg.head.arg1 & 0xffffffff;
+      int nw = 0;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        epoch_.store(msg.head.arg0);
+        size_t n = msg.payload.size() / sizeof(NodeInfo);
+        if (n > 0) {
+          nodes_.resize(n);
+          memcpy(nodes_.data(), msg.payload.data(),
+                 n * sizeof(NodeInfo));
+        }
+        for (const auto& node : nodes_) {
+          if (node.role == ROLE_WORKER) ++nw;
+        }
+        if (nw > 0) num_workers_.store(nw);
+      }
+      BPS_METRIC_GAUGE_SET("bps_membership_epoch", epoch_.load());
+      BPS_METRIC_GAUGE_SET("bps_fleet_workers", num_workers_.load());
+      BPS_LOG(WARNING) << "node " << my_id_ << ": epoch "
+                       << msg.head.arg0 << " FLEET_RESUME — fleet is "
+                       << num_workers_.load() << " worker(s)"
+                       << (kind == 0 ? " (joined: " : " (removed: ")
+                       << affected << ")";
+      Trace::Get().Note("FLEET_RESUME", msg.head.arg0, affected, -1,
+                        kind);
+      Trace::Get().FlightDumpAuto("fleet_resume");
+      if (role_ == ROLE_SERVER && fleet_resize_cb_) {
+        fleet_resize_cb_(kind, affected, jr, jb);
+      }
+      if (role_ == ROLE_WORKER && fleet_resume_cb_) {
+        fleet_resume_cb_(kind, affected, jr, jb);
+      }
+      break;
+    }
     case CMD_SHUTDOWN: {
       if (role_ == ROLE_SCHEDULER) {
         // A worker says goodbye; when all workers are done, stop the fleet.
         std::lock_guard<std::mutex> lk(mu_);
+        // A rank that already LEFT (or was shrunk away) owes no
+        // goodbye; a stale one must not skew the quorum count.
+        bool known = false;
+        for (const auto& n : nodes_) {
+          if (n.id == msg.head.sender) { known = true; break; }
+        }
+        if (!known) break;
         // A cleanly-departing node is not a failure: stop tracking it.
         last_heartbeat_ms_.erase(msg.head.sender);
         departed_.insert(msg.head.sender);
@@ -827,6 +1029,249 @@ void Postoffice::HandleRecoverRegister(int fd, const NodeInfo& info,
   Trace::Get().FlightDumpAuto("epoch_resume");
 }
 
+// --- elastic worker membership (ISSUE 8) ------------------------------------
+
+void Postoffice::HandleJoinRequest(Message&& msg, int fd) {
+  if (role_ != ROLE_SCHEDULER) {
+    BPS_LOG(WARNING) << "node " << my_id_
+                     << ": unexpected CMD_JOIN_REQUEST — ignored";
+    return;
+  }
+  BPS_CHECK_EQ(msg.payload.size(), sizeof(NodeInfo));
+  MemberOp op;
+  op.kind = 0;
+  op.fd = fd;
+  memcpy(&op.info, msg.payload.data(), sizeof(NodeInfo));
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!addrbook_ready_) {
+    BPS_LOG(WARNING) << "scheduler: join request before fleet formation "
+                        "— ignored (join a RUNNING fleet)";
+    return;
+  }
+  if (!ElasticEnabled()) {
+    // Ignored, not crashed: the joiner's own PS_TOPOLOGY_TIMEOUT fails
+    // it loudly with the fix named in its log.
+    BPS_LOG(WARNING) << "scheduler: join request but BYTEPS_ELASTIC is "
+                        "off — ignored (set BYTEPS_ELASTIC=1 fleet-wide "
+                        "to allow membership changes)";
+    return;
+  }
+  BPS_LOG(WARNING) << "scheduler: worker join request from "
+                   << op.info.host << ":" << op.info.port;
+  member_queue_.push_back(std::move(op));
+  if (!member_active_) {
+    MemberOp next = std::move(member_queue_.front());
+    member_queue_.pop_front();
+    StartMemberOpLocked(std::move(next));
+  }
+}
+
+void Postoffice::HandleLeaveRequest(const Message& msg, int fd) {
+  if (role_ != ROLE_SCHEDULER) return;
+  const int id = msg.head.sender;
+  std::lock_guard<std::mutex> lk(mu_);
+  bool known = false;
+  for (const auto& n : nodes_) {
+    if (n.id == id && n.role == ROLE_WORKER) { known = true; break; }
+  }
+  if (!known || !ElasticEnabled()) {
+    BPS_LOG(WARNING) << "scheduler: leave request from node " << id
+                     << (known ? " but BYTEPS_ELASTIC is off"
+                               : " which is not a fleet worker")
+                     << " — ignored";
+    return;
+  }
+  // The leaver's heartbeats stop the moment it exits; stop failure
+  // tracking NOW so its departure can never read as a death.
+  last_heartbeat_ms_.erase(id);
+  departed_.insert(id);
+  // Unblock the leaver immediately: its drained state is all the fleet
+  // needs from it, and the RESUME below never addresses it.
+  MsgHeader ack{};
+  ack.cmd = CMD_LEAVE_ACK;
+  ack.sender = kSchedulerId;
+  van_->Send(fd, ack);
+  BPS_LOG(WARNING) << "scheduler: worker " << id << " leaving gracefully";
+  MemberOp op;
+  op.kind = 1;
+  op.node_id = id;
+  member_queue_.push_back(std::move(op));
+  if (!member_active_) {
+    MemberOp next = std::move(member_queue_.front());
+    member_queue_.pop_front();
+    StartMemberOpLocked(std::move(next));
+  }
+}
+
+void Postoffice::StartMemberOpLocked(MemberOp&& op) {
+  member_active_ = true;
+  member_op_ = std::move(op);
+  member_start_ms_ = NowMs();
+  member_deadline_ms_ = member_start_ms_ + ElasticTimeoutMs();
+  member_round_max_ = 0;
+  member_bcast_max_ = 0;
+  pause_acks_pending_.clear();
+  epoch_.fetch_add(1);
+  BPS_METRIC_GAUGE_SET("bps_membership_epoch", epoch_.load());
+  BPS_METRIC_GAUGE_SET("bps_fleet_resizing", 1);
+  Trace::Get().Note("FLEET_PAUSE", epoch_.load(), member_op_.node_id,
+                    -1, member_op_.kind);
+  Trace::Get().FlightDumpAuto("fleet_pause");
+  BPS_LOG(WARNING) << "scheduler: epoch " << epoch_.load()
+                   << " worker membership change — "
+                   << (member_op_.kind == 0 ? "join" :
+                       member_op_.kind == 1 ? "graceful leave" :
+                       "death shrink")
+                   << (member_op_.kind == 0 ? ""
+                       : " of node " + std::to_string(member_op_.node_id));
+  MsgHeader h{};
+  h.cmd = CMD_FLEET_PAUSE;
+  h.sender = kSchedulerId;
+  h.arg0 = epoch_.load();
+  h.version = member_op_.kind;
+  h.key = member_op_.node_id;
+  for (const auto& n : nodes_) {
+    if (n.id == kSchedulerId || n.id == member_op_.node_id) continue;
+    auto it = node_fd_.find(n.id);
+    if (it != node_fd_.end()) van_->Send(it->second, h);
+    if (member_op_.kind == 0 && n.role == ROLE_WORKER) {
+      pause_acks_pending_.insert(n.id);
+    }
+  }
+  // Joins wait for every worker's gated-counter ack (the activation
+  // round is their max); removals commit immediately — the departed
+  // rank is in no incomplete round once the server rollback runs.
+  if (member_op_.kind != 0 || pause_acks_pending_.empty()) {
+    CompleteMemberOpLocked();
+  }
+}
+
+void Postoffice::CompleteMemberOpLocked() {
+  MemberOp& op = member_op_;
+  const int64_t packed =
+      (member_round_max_ << 32) | (member_bcast_max_ & 0xffffffff);
+  if (op.kind == 0) {
+    // Fresh, never-reused rank: a joined worker's id (and therefore
+    // its trace identity and monitor endpoint port) cannot collide
+    // with any past member's.
+    const int rank = next_worker_rank_++;
+    const int id = WorkerId(rank);
+    NodeInfo adopted = op.info;
+    adopted.id = id;
+    adopted.role = ROLE_WORKER;
+    nodes_.push_back(adopted);
+    node_fd_[id] = op.fd;
+    last_heartbeat_ms_[id] = NowMs();
+    num_workers_.fetch_add(1);
+    op.node_id = id;
+    BPS_METRIC_COUNTER_ADD("bps_worker_joins_total", 1);
+    // The joiner's direct ADDRBOOK: assigned id + the round boundary
+    // it enters at (every existing worker's counters were gated at or
+    // below it, so the joiner's first push is the first round the new
+    // roster expects it in).
+    MsgHeader ab{};
+    ab.cmd = CMD_ADDRBOOK;
+    ab.sender = kSchedulerId;
+    ab.arg0 = id;
+    ab.arg1 = packed;
+    van_->Send(op.fd, ab, nodes_.data(),
+               nodes_.size() * sizeof(NodeInfo));
+    BPS_LOG(WARNING) << "scheduler: worker joined as rank " << rank
+                     << " (node " << id << ", round "
+                     << member_round_max_ << ") — fleet is "
+                     << num_workers_.load() << " worker(s)";
+  } else {
+    for (auto it = nodes_.begin(); it != nodes_.end(); ++it) {
+      if (it->id == op.node_id) {
+        nodes_.erase(it);
+        break;
+      }
+    }
+    // The fd is NOT force-closed here: a leaver closes its own side at
+    // exit and a dead worker's socket is already gone — the van owns
+    // reaping either way.
+    node_fd_.erase(op.node_id);
+    num_workers_.fetch_sub(1);
+    BPS_METRIC_COUNTER_ADD("bps_worker_leaves_total", 1);
+    BPS_LOG(WARNING) << "scheduler: worker " << op.node_id
+                     << (op.kind == 1 ? " left" : " shrunk away")
+                     << " — fleet is " << num_workers_.load()
+                     << " worker(s)";
+  }
+  BPS_METRIC_GAUGE_SET("bps_fleet_workers", num_workers_.load());
+  BPS_METRIC_GAUGE_SET("bps_fleet_resizing", 0);
+  BPS_METRIC_GAUGE_SET("bps_epoch_change_ms",
+                       NowMs() - member_start_ms_);
+  Trace::Get().Note("FLEET_RESUME", epoch_.load(), op.node_id, -1,
+                    op.kind);
+  Trace::Get().FlightDumpAuto("fleet_resume");
+  MsgHeader rs{};
+  rs.cmd = CMD_FLEET_RESUME;
+  rs.sender = kSchedulerId;
+  rs.arg0 = epoch_.load();
+  rs.version = op.kind;
+  rs.key = op.node_id;
+  rs.arg1 = packed;
+  for (const auto& n : nodes_) {
+    if (n.id == kSchedulerId) continue;
+    auto it = node_fd_.find(n.id);
+    if (it != node_fd_.end()) {
+      van_->Send(it->second, rs, nodes_.data(),
+                 nodes_.size() * sizeof(NodeInfo));
+    }
+  }
+  member_active_ = false;
+  member_deadline_ms_ = 0;
+  if (num_workers_.load() == 0) {
+    // The last worker left: nobody remains to say goodbye, so the
+    // all-goodbyes quorum can never fire — tear down cleanly now.
+    BPS_LOG(WARNING) << "scheduler: last worker left — clean fleet "
+                        "shutdown";
+    MsgHeader sh{};
+    sh.cmd = CMD_SHUTDOWN;
+    sh.sender = kSchedulerId;
+    for (const auto& n : nodes_) {
+      if (n.id == kSchedulerId) continue;
+      auto it = node_fd_.find(n.id);
+      if (it != node_fd_.end()) van_->Send(it->second, sh);
+    }
+    shutting_down_.store(true);
+    cv_.notify_all();
+    return;
+  }
+  if (!member_queue_.empty()) {
+    MemberOp next = std::move(member_queue_.front());
+    member_queue_.pop_front();
+    StartMemberOpLocked(std::move(next));
+  }
+}
+
+void Postoffice::SendFleetPauseAck(int64_t max_round, int64_t max_bcast) {
+  MsgHeader h{};
+  h.cmd = CMD_FLEET_PAUSE_ACK;
+  h.sender = my_id_;
+  h.arg0 = max_round;
+  h.arg1 = max_bcast;
+  van_->Send(FdOf(kSchedulerId), h);
+}
+
+bool Postoffice::RequestLeave() {
+  if (role_ != ROLE_WORKER) return false;
+  MsgHeader h{};
+  h.cmd = CMD_LEAVE_REQUEST;
+  h.sender = my_id_;
+  if (!van_->Send(FdOf(kSchedulerId), h)) return false;
+  std::unique_lock<std::mutex> lk(mu_);
+  cv_.wait_for(lk, std::chrono::seconds(60), [this] {
+    return leave_acked_ || shutting_down_.load();
+  });
+  if (leave_acked_) {
+    BPS_LOG(WARNING) << "worker " << my_id_
+                     << ": graceful leave acknowledged — departing";
+  }
+  return leave_acked_;
+}
+
 bool Postoffice::DialReplacement(int node_id, const NodeInfo& info) {
   int streams = 1;
   if (const char* sv = getenv("BYTEPS_VAN_STREAMS")) {
@@ -1009,7 +1454,9 @@ std::vector<std::pair<int, int64_t>> Postoffice::HeartbeatAges() {
 
 void Postoffice::Finalize() {
   if (!van_) return;
-  if (shutting_down_.load()) {
+  if (shutting_down_.load() || left_.load()) {
+    // A rank that gracefully LEFT is out of the fleet's shutdown
+    // quorum: it owes no goodbye and waits on nothing.
     van_->Stop();
   } else if (role_ == ROLE_WORKER) {
     // Say goodbye, then wait for the scheduler's fleet-wide SHUTDOWN

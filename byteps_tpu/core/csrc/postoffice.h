@@ -9,6 +9,7 @@
 
 #include <atomic>
 #include <condition_variable>
+#include <deque>
 #include <functional>
 #include <map>
 #include <mutex>
@@ -90,6 +91,41 @@ class Postoffice {
     peer_recovered_cb_ = std::move(cb);
   }
 
+  // Elastic worker membership (ISSUE 8). Pause: a JOIN-kind
+  // CMD_FLEET_PAUSE arrived — the worker gates new rounds and answers
+  // with its round counters (the KV layer's in-flight rounds complete
+  // against the OLD roster, so no drain wait). Resume: the change is
+  // committed — sync counters (join) and lift the gate. Resize (server
+  // role): update the roster history; a removal additionally rolls the
+  // in-flight rounds back. All run on van recv threads.
+  void SetFleetPauseCallback(std::function<void(int kind)> cb) {
+    fleet_pause_cb_ = std::move(cb);
+  }
+  void SetFleetResumeCallback(
+      std::function<void(int kind, int affected, int64_t join_round,
+                         int64_t join_bcast)> cb) {
+    fleet_resume_cb_ = std::move(cb);
+  }
+  void SetFleetResizeCallback(
+      std::function<void(int kind, int affected, int64_t join_round,
+                         int64_t join_bcast)> cb) {
+    fleet_resize_cb_ = std::move(cb);
+  }
+
+  // Worker: gated-round counters -> scheduler (join drain-free ack).
+  void SendFleetPauseAck(int64_t max_round, int64_t max_bcast);
+
+  // Worker: graceful leave. Sends CMD_LEAVE_REQUEST (the caller must
+  // have drained its handles first) and waits for the scheduler's
+  // CMD_LEAVE_ACK. After a true return, Finalize skips the goodbye —
+  // this rank no longer counts toward the fleet's shutdown quorum.
+  bool RequestLeave();
+
+  // Joiner: the round boundary this rank enters at (from the direct
+  // ADDRBOOK's arg1; 0 on ordinary formation).
+  int64_t join_round() const { return join_round_.load(); }
+  int64_t join_bcast_round() const { return join_bcast_.load(); }
+
   // Current membership epoch (bumped by the scheduler per recovery) and
   // whether any rank is mid-recovery from this node's point of view.
   int64_t epoch() const { return epoch_.load(); }
@@ -105,7 +141,9 @@ class Postoffice {
   // --- topology queries ---
   int my_id() const { return my_id_; }
   Role role() const { return role_; }
-  int num_workers() const { return num_workers_; }
+  // LIVE fleet size: elastic joins/leaves/shrinks update it mid-run
+  // (CMD_FLEET_RESUME recounts it from the re-issued address book).
+  int num_workers() const { return num_workers_.load(); }
   int num_servers() const { return num_servers_; }
   // node ids: scheduler 0, servers 1..S, workers S+1..S+W
   static int ServerId(int s) { return 1 + s; }
@@ -139,6 +177,22 @@ class Postoffice {
  private:
   void ControlHandler(Message&& msg, int fd);
   void HeartbeatLoop();
+  // Elastic worker membership (scheduler; caller holds mu_). A queued
+  // membership op starts when no other is active: bump the epoch,
+  // broadcast CMD_FLEET_PAUSE, and — join only — wait for every
+  // worker's gated-counter ack before committing. Leaves and death
+  // shrinks commit immediately (no drain needed; the server rollback
+  // owns in-flight rounds).
+  struct MemberOp {
+    int kind = 0;      // 0 join, 1 leave, 2 death shrink
+    int fd = -1;       // joiner's scheduler connection
+    NodeInfo info{};   // joiner's advertised address
+    int node_id = -1;  // leaver / dead worker id
+  };
+  void StartMemberOpLocked(MemberOp&& op);
+  void CompleteMemberOpLocked();
+  void HandleJoinRequest(Message&& msg, int fd);
+  void HandleLeaveRequest(const Message& msg, int fd);
   // Scheduler: enter RECOVERY for a dead server rank — bump the epoch,
   // broadcast CMD_EPOCH_PAUSE, and arm the replacement-wait deadline.
   // Caller holds mu_.
@@ -167,7 +221,7 @@ class Postoffice {
   AppHandler app_handler_;
   Role role_ = ROLE_WORKER;
   int my_id_ = -1;
-  int num_workers_ = 0;
+  std::atomic<int> num_workers_{0};  // live (elastic membership)
   int num_servers_ = 0;
   std::atomic<bool> shutting_down_{false};
   std::atomic<bool> failure_shutdown_{false};
@@ -200,6 +254,9 @@ class Postoffice {
   std::function<void(int)> peer_reconnected_cb_;
   std::function<void(int)> peer_paused_cb_;
   std::function<void(int)> peer_recovered_cb_;
+  std::function<void(int)> fleet_pause_cb_;
+  std::function<void(int, int, int64_t, int64_t)> fleet_resume_cb_;
+  std::function<void(int, int, int64_t, int64_t)> fleet_resize_cb_;
 
   // Hot-server-replacement state (guarded by mu_ unless atomic).
   std::atomic<int64_t> epoch_{0};          // fleet membership epoch
@@ -224,6 +281,27 @@ class Postoffice {
   int recovering_node_ = -1;
   int64_t recovery_deadline_ms_ = 0;
 
+  // Elastic worker membership (scheduler state, guarded by mu_).
+  // Worker ranks are allocated monotonically and NEVER reused: a joined
+  // worker's rank (and therefore node id, trace identity, and monitor
+  // endpoint port) can never collide with a departed one's.
+  int next_worker_rank_ = -1;
+  std::deque<MemberOp> member_queue_;
+  bool member_active_ = false;
+  MemberOp member_op_{};
+  std::set<int> pause_acks_pending_;   // worker ids still to ack (join)
+  int64_t member_round_max_ = 0;       // fleet max round counter (join)
+  int64_t member_bcast_max_ = 0;
+  int64_t member_start_ms_ = 0;
+  int64_t member_deadline_ms_ = 0;     // fail-stop fallback
+
+  // Worker: joiner's activation rounds (direct ADDRBOOK arg1) and the
+  // graceful-leave handshake state.
+  std::atomic<int64_t> join_round_{0};
+  std::atomic<int64_t> join_bcast_{0};
+  bool leave_acked_ = false;           // guarded by mu_
+  std::atomic<bool> left_{false};      // leave committed: no goodbye owed
+
   // Heartbeat-echo clock estimate (see ClockOffsetUs).
   std::atomic<int64_t> clock_offset_us_{0};
   std::atomic<int64_t> clock_rtt_us_{-1};
@@ -242,5 +320,14 @@ bool RetryEnabled();
 // fleet-wide failure SHUTDOWN.
 bool RecoveryEnabled();
 int64_t RecoveryTimeoutMs();
+
+// Elastic worker membership master switch: BYTEPS_ELASTIC=1. Requires
+// the retry layer (config.py validates; the C side reads the env
+// directly). With it OFF, any worker death keeps the PR 3 fail-stop
+// contract byte for byte.
+bool ElasticEnabled();
+// Fail-stop fallback window for a membership change that cannot commit
+// (a worker never acks the join gate): BYTEPS_ELASTIC_TIMEOUT_MS.
+int64_t ElasticTimeoutMs();
 
 }  // namespace bps

@@ -2,6 +2,7 @@
 
 #include <sys/uio.h>
 
+#include <algorithm>
 #include <cstring>
 
 #include "cpu_reducer.h"
@@ -12,6 +13,13 @@
 #include "worker.h"  // NowUs
 
 namespace bps {
+
+namespace {
+// Internal engine-queue marker (never on the wire): a death-shrink
+// rollback task, one per engine thread so each rolls back exactly the
+// keys it owns — per-key total ordering holds through the rollback.
+constexpr int32_t kCmdShrink = -100;
+}  // namespace
 
 void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
   po_ = po;
@@ -28,6 +36,24 @@ void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
   if (const char* qm = getenv("BYTEPS_WIRE_QUANT_MIN_BYTES")) {
     quant_min_bytes_ = atoll(qm);
     if (quant_min_bytes_ < 0) quant_min_bytes_ = 0;
+  }
+  // Elastic worker membership (ISSUE 8): arm the per-epoch contributor
+  // rosters. Start runs before the postoffice forms the fleet, so the
+  // initial roster comes from the formation env (worker ids 1+S..S+W —
+  // the postoffice id layout); membership changes arrive later through
+  // OnFleetResize.
+  if (const char* ev = getenv("BYTEPS_ELASTIC")) {
+    elastic_ = atoi(ev) != 0;
+  }
+  if (elastic_) {
+    int nw = 1, ns = 1;
+    if (const char* v = getenv("DMLC_NUM_WORKER")) nw = atoi(v);
+    if (const char* v = getenv("DMLC_NUM_SERVER")) ns = atoi(v);
+    std::set<int> live;
+    for (int w = 0; w < nw; ++w) live.insert(1 + ns + w);
+    roster_.Init(live);
+    BPS_LOG(INFO) << "server: elastic worker membership armed ("
+                  << nw << " initial worker(s))";
   }
   const char* rr = getenv("DMLC_RECOVER_RANK");
   recover_mode_.store(rr && *rr);
@@ -253,8 +279,160 @@ void BytePSServer::EngineLoop(int tid) {
       task = std::move(eq.q.front());
       eq.q.pop_front();
     }
+    if (task.msg.head.cmd == kCmdShrink) {
+      ShrinkWorker(tid, static_cast<int>(task.msg.head.arg0));
+      continue;
+    }
     Process(std::move(task));
   }
+}
+
+void BytePSServer::OnFleetResize(int kind, int affected,
+                                 int64_t join_round, int64_t join_bcast) {
+  if (!elastic_) return;
+  if (kind == 0) {
+    // Join: a fresh roster epoch activates at the gated round boundary.
+    // Rounds already in flight keep completing against the old set —
+    // no store surgery needed. The re-eval tasks below (affected = -1:
+    // nothing to discard) close a race: a member's first new-roster
+    // push can arrive on a data connection BEFORE this control-plane
+    // RESUME was processed, in which case its completion check ran
+    // against the stale roster and nothing later would re-trigger it.
+    roster_.Join(affected, join_round, join_bcast);
+    BPS_LOG(WARNING) << "server: roster epoch — worker " << affected
+                     << " joins at round " << join_round;
+    for (auto& eq : queues_) {
+      EngineTask t;
+      t.msg.head.cmd = kCmdShrink;
+      t.msg.head.arg0 = -1;
+      {
+        std::lock_guard<std::mutex> lk(eq->mu);
+        eq->q.push_back(std::move(t));
+      }
+      eq->cv.notify_one();
+    }
+    return;
+  }
+  // Removal: erase the id from EVERY roster (a leaver drained before
+  // leaving, and a dead rank's partial contributions are discarded by
+  // the rollback below — so no incomplete round legitimately expects
+  // it), then re-evaluate each engine thread's keys: blocked rounds
+  // whose only missing contributor was the departed rank become ready.
+  roster_.Remove(affected);
+  BPS_LOG(WARNING) << "server: roster epoch — worker " << affected
+                   << (kind == 1 ? " left" : " died")
+                   << "; rolling in-flight rounds onto the survivors";
+  for (auto& eq : queues_) {
+    EngineTask t;
+    t.msg.head.cmd = kCmdShrink;
+    t.msg.head.arg0 = affected;
+    {
+      std::lock_guard<std::mutex> lk(eq->mu);
+      eq->q.push_back(std::move(t));
+    }
+    eq->cv.notify_one();
+  }
+}
+
+int BytePSServer::ExpectedContributors(int64_t version) {
+  if (!elastic_) return po_->num_workers();
+  return static_cast<int>(roster_.OfRound(version)->size());
+}
+
+bool BytePSServer::RoundComplete(KeyStore* ks, int slot, int64_t version) {
+  if (!elastic_) return ks->push_count[slot] == po_->num_workers();
+  auto roster = roster_.OfRound(version);
+  return !roster->empty() && ks->er[slot].PushersMatch(*roster);
+}
+
+bool BytePSServer::RoundServed(KeyStore* ks, int slot, int64_t version) {
+  if (!elastic_) return ks->pull_count[slot] == po_->num_workers();
+  auto roster = roster_.OfRound(version);
+  return !roster->empty() && ks->er[slot].PullersCover(*roster);
+}
+
+void BytePSServer::ShrinkWorker(int tid, int dead) {
+  std::vector<KeyStore*> mine;
+  {
+    std::lock_guard<std::mutex> lk(store_mu_);
+    for (auto& kv : store_) {
+      if (static_cast<size_t>(kv.first) % queues_.size() ==
+          static_cast<size_t>(tid)) {
+        mine.push_back(kv.second.get());
+      }
+    }
+  }
+  auto drop_sender = [dead](std::vector<EngineTask>& v) {
+    v.erase(std::remove_if(v.begin(), v.end(),
+                           [dead](const EngineTask& t) {
+                             return t.msg.head.sender == dead;
+                           }),
+            v.end());
+  };
+  int rolled = 0, completed = 0;
+  for (KeyStore* ks : mine) {
+    if (dead >= 0) {
+      ks->seen.erase(dead);
+      ks->pending_bcast_pulls.erase(
+          std::remove_if(ks->pending_bcast_pulls.begin(),
+                         ks->pending_bcast_pulls.end(),
+                         [dead](const std::pair<int, MsgHeader>& p) {
+                           return p.second.sender == dead;
+                         }),
+          ks->pending_bcast_pulls.end());
+    }
+    for (int slot = 0; slot < 2; ++slot) {
+      if (dead >= 0) {
+        drop_sender(ks->parked_pushes[slot]);
+        drop_sender(ks->pending_pulls[slot]);
+      }
+      if (dead >= 0 && !ks->ready[slot] && ks->push_count[slot] > 0) {
+        // In-flight round: discard the departed rank's partial
+        // contribution and rebuild the sum from the survivors'
+        // retained bytes — the aggregate is then exactly the sum over
+        // the round's post-shrink roster, never a mix.
+        if (ks->er[slot].Remove(dead)) {
+          --ks->push_count[slot];
+          ++rolled;
+          if (ks->push_count[slot] == 0) {
+            ks->round[slot] = -1;
+          } else {
+            BPS_CHECK(ks->er[slot].RebuildSum(
+                ks->slot[slot].data(),
+                static_cast<int64_t>(ks->slot[slot].size()), ks->dtype))
+                << "elastic rollback lost the surviving contributions "
+                   "for a slot with push_count > 0";
+          }
+        }
+      }
+      // Re-evaluate against the shrunk roster: a round whose only
+      // missing contributor was the departed rank becomes ready (its
+      // parked pulls get served), and a ready round every survivor
+      // already pulled recycles.
+      if (!ks->ready[slot] && ks->push_count[slot] > 0 &&
+          RoundComplete(ks, slot, ks->round[slot])) {
+        ++completed;
+        RoundReady(ks, slot);
+      } else if (ks->ready[slot] &&
+                 RoundServed(ks, slot, ks->round[slot])) {
+        ks->last_round[slot] = ks->round[slot];
+        ks->last_contrib_n[slot] = ks->contrib_n[slot];
+        ks->push_count[slot] = 0;
+        ks->pull_count[slot] = 0;
+        ks->ready[slot] = false;
+        ks->round[slot] = -1;
+        ks->er[slot].Reset();
+        ReplayParked(ks, slot);
+      }
+    }
+  }
+  if (rolled || completed) {
+    BPS_LOG(WARNING) << "server: rollback for departed worker " << dead
+                     << " (engine " << tid << "): discarded " << rolled
+                     << " partial contribution(s), completed "
+                     << completed << " round(s) on the survivors";
+  }
+  if (dead >= 0) Trace::Get().Note("WORKER_SHRINK", rolled, dead, -1, completed);
 }
 
 BytePSServer::KeyStore* BytePSServer::GetStore(int64_t key) {
@@ -623,38 +801,18 @@ void BytePSServer::Process(EngineTask&& task) {
           BPS_METRIC_HISTO_OBSERVE("bps_server_sum_us", NowUs() - t_sum);
           BPS_METRIC_COUNTER_ADD("bps_server_sum_bytes_total", data_len);
         }
-        if (++ks->push_count[slot] == po_->num_workers()) {
-          ks->ready[slot] = true;
-          ks->pull_count[slot] = 0;
-          if (ks->reply_comp) {
-            // Encode once per round; every worker's reply ships the same
-            // compressed aggregate (and EF state advances once).
-            ks->reply_comp->Compress(
-                reinterpret_cast<const float*>(ks->slot[slot].data()),
-                ks->len / static_cast<int64_t>(sizeof(float)),
-                &ks->comp_reply[slot]);
-          } else if (ks->quant_ok) {
-            // Re-quantize the aggregate once per round; every flagged
-            // pull (and every dedup replay) serves the same cached
-            // bytes, so replies stay deterministic under chaos.
-            EncodeQuantReply(ks, slot);
-          }
-          // Release pulls that arrived before the last push — but only
-          // this round's; a later round's pulls stay parked. Move the
-          // list out first: ReplyPull may recycle the slot, and its
-          // replay can append fresh entries.
-          std::vector<EngineTask> waiting;
-          waiting.swap(ks->pending_pulls[slot]);
-          bool recycled = false;
-          for (auto& p : waiting) {
-            if (p.msg.head.version == h.version) {
-              recycled |= ReplyPull(ks, slot, p);
-            } else {
-              ks->pending_pulls[slot].push_back(std::move(p));
-            }
-          }
-          if (recycled) ReplayParked(ks, slot);
-        }
+        ++ks->push_count[slot];
+        // Elastic roster bookkeeping (ISSUE 8): who contributed, and a
+        // retained copy of the DECODED bytes so a death shrink can
+        // discard a departed rank's partial sum and rebuild exactly
+        // from the survivors. Copies are freed at round ready.
+        if (elastic_) ks->er[slot].Push(h.sender, data, data_len);
+        // Completion: every contributor the round's roster expects has
+        // pushed. Elastic compares the contributor SET against the
+        // round's epoch roster (rounds in flight across a membership
+        // change complete against the roster they started under);
+        // non-elastic keeps the fixed-count check byte for byte.
+        if (RoundComplete(ks, slot, h.version)) RoundReady(ks, slot);
       }
       if (t_trace) {
         Trace::Get().Span("s_sum", h.key, t_trace, NowUs(), h.sender,
@@ -756,6 +914,9 @@ void BytePSServer::Process(EngineTask&& task) {
           !slot_owned_by_newer) {
         ks->slot[slot].assign(msg.payload.begin(), msg.payload.end());
         ks->last_round[slot] = h.version;
+        // The reseed IS a completed round's sum over the then-full
+        // fleet: its mean divisor is the current worker count.
+        ks->last_contrib_n[slot] = po_->num_workers();
         // The slot may already be accumulating this round from
         // recovery re-pushes that arrived first; the reseed IS that
         // round's final sum — supersede the partial accumulation.
@@ -764,6 +925,7 @@ void BytePSServer::Process(EngineTask&& task) {
           ks->push_count[slot] = 0;
           ks->pull_count[slot] = 0;
           ks->ready[slot] = false;
+          if (elastic_) ks->er[slot].Reset();
         }
         ks->comp_reply[slot].clear();
         // The quantized-reply cache is stale too: a re-seeded slot
@@ -800,11 +962,19 @@ void BytePSServer::Process(EngineTask&& task) {
       ks->param.assign(msg.payload.begin(), msg.payload.end());
       ks->param_init = true;
       ks->last_bcast_round = round;  // bcast-pull replay fallback
-      int waiters = po_->num_workers() - 1;
+      // Non-root pulls this round expects: the round's roster size
+      // minus the root. Broadcasts count rounds in their own space, so
+      // a join's bcast activation point picks the roster (ISSUE 8).
+      int waiters =
+          (elastic_
+               ? static_cast<int>(roster_.OfBcast(round)->size())
+               : po_->num_workers()) -
+          1;
       if (waiters > 0) {
         auto& br = ks->bcast_rounds[round];
         br.data.assign(msg.payload.begin(), msg.payload.end());
         br.served = 0;
+        br.waiters = waiters;
         // Bound stale-round growth: a worker this far behind the root
         // would already trip heartbeat failure detection, so dropping
         // the oldest unserved round only trades a hang for a hang —
@@ -910,6 +1080,9 @@ void BytePSServer::ServeRetainedPull(KeyStore* ks, int slot,
   resp.req_id = req.req_id;
   resp.dtype = ks->dtype;
   resp.version = req.version;
+  // Mean divisor of the RETAINED round (set at recycle / reseed).
+  resp.arg1 = ks->last_contrib_n[slot] > 0 ? ks->last_contrib_n[slot]
+                                           : ks->contrib_n[slot];
   if (ks->reply_comp && !ks->comp_reply[slot].empty()) {
     // Normal-operation replay window: the cached encode is still valid
     // for this round. (A re-seeded slot clears it and serves raw.)
@@ -955,6 +1128,45 @@ void BytePSServer::ServeRetainedPull(KeyStore* ks, int slot,
   }
 }
 
+void BytePSServer::RoundReady(KeyStore* ks, int slot) {
+  ks->ready[slot] = true;
+  ks->pull_count[slot] = 0;
+  // The round's contributor count is FINAL here: it rides every sync
+  // PULL_RESP's arg1 as the worker-side mean divisor, so a pull issued
+  // under an older fleet size still divides by this round's roster.
+  ks->contrib_n[slot] = ks->push_count[slot];
+  if (elastic_) ks->er[slot].SealPushes();
+  if (ks->reply_comp) {
+    // Encode once per round; every worker's reply ships the same
+    // compressed aggregate (and EF state advances once).
+    ks->reply_comp->Compress(
+        reinterpret_cast<const float*>(ks->slot[slot].data()),
+        ks->len / static_cast<int64_t>(sizeof(float)),
+        &ks->comp_reply[slot]);
+  } else if (ks->quant_ok) {
+    // Re-quantize the aggregate once per round; every flagged pull
+    // (and every dedup replay) serves the same cached bytes, so
+    // replies stay deterministic under chaos.
+    EncodeQuantReply(ks, slot);
+  }
+  // Release pulls that arrived before the last push — but only this
+  // round's; a later round's pulls stay parked. Move the list out
+  // first: ReplyPull may recycle the slot, and its replay can append
+  // fresh entries.
+  const int ver = ks->round[slot];
+  std::vector<EngineTask> waiting;
+  waiting.swap(ks->pending_pulls[slot]);
+  bool recycled = false;
+  for (auto& p : waiting) {
+    if (p.msg.head.version == ver) {
+      recycled |= ReplyPull(ks, slot, p);
+    } else {
+      ks->pending_pulls[slot].push_back(std::move(p));
+    }
+  }
+  if (recycled) ReplayParked(ks, slot);
+}
+
 bool BytePSServer::ReplyPull(KeyStore* ks, int slot, const EngineTask& t) {
   const MsgHeader& req = t.msg.head;
   const int64_t t_trace = Trace::Get().MainOn() ? NowUs() : 0;
@@ -965,6 +1177,12 @@ bool BytePSServer::ReplyPull(KeyStore* ks, int slot, const EngineTask& t) {
   resp.req_id = req.req_id;
   resp.dtype = ks->dtype;
   resp.version = req.version;
+  // Sync mean divisor (ISSUE 8): the round's ACTUAL contributor count.
+  // A pull issued before a membership change captured a stale fleet
+  // size; the worker divides by this instead, so every aggregate is an
+  // exact mean over the round's roster. (Async replies carry their
+  // apply counter in arg1 through their own branch, untouched.)
+  resp.arg1 = ks->contrib_n[slot];
   if (ks->reply_comp && !ks->comp_reply[slot].empty()) {
     resp.flags = FLAG_COMPRESSED;
     resp.arg0 = ks->len;  // decompressed size, for the worker's check
@@ -1006,7 +1224,9 @@ bool BytePSServer::ReplyPull(KeyStore* ks, int slot, const EngineTask& t) {
     Trace::Get().Flow(TRACE_FLOW_STEP, "reply", req.key, t_trace,
                       TraceFlowId(req.sender, req.req_id));
   }
-  if (++ks->pull_count[slot] == po_->num_workers()) {
+  ++ks->pull_count[slot];
+  if (elastic_) ks->er[slot].Pull(req.sender);
+  if (RoundServed(ks, slot, req.version)) {
     // Round fully served; recycle the slot for round r+2. The slot's
     // DATA (and cached compressed encode) are deliberately retained:
     // they are the replay window for a pull whose response was lost in
@@ -1014,10 +1234,12 @@ bool BytePSServer::ReplyPull(KeyStore* ks, int slot, const EngineTask& t) {
     // assigns over them — which per-key chaining delays until every
     // worker provably received this round).
     ks->last_round[slot] = ks->round[slot];
+    ks->last_contrib_n[slot] = ks->contrib_n[slot];
     ks->push_count[slot] = 0;
     ks->pull_count[slot] = 0;
     ks->ready[slot] = false;
     ks->round[slot] = -1;
+    if (elastic_) ks->er[slot].Reset();
     return true;
   }
   return false;
@@ -1061,7 +1283,19 @@ void BytePSServer::ServeBcastRound(KeyStore* ks, int round, int fd,
   resp.version = round;
   MarkReplied(ks, req.sender, req.req_id, resp);
   po_->van().Send(fd, resp, it->second.data.data(), it->second.data.size());
-  if (++it->second.served >= po_->num_workers() - 1) {
+  // Waiter quota frozen at push time (see HandleBcastPush) — except
+  // that a push racing ahead of this server's FLEET_RESUME can have
+  // frozen a stale (smaller) roster; taking the max against the
+  // round's CURRENT roster keeps the round alive for the joiner's
+  // pull instead of erasing it one pull early.
+  int waiters =
+      it->second.waiters > 0 ? it->second.waiters : po_->num_workers() - 1;
+  if (elastic_) {
+    waiters = std::max(
+        waiters,
+        static_cast<int>(roster_.OfBcast(round)->size()) - 1);
+  }
+  if (++it->second.served >= waiters) {
     ks->bcast_rounds.erase(it);
   }
 }

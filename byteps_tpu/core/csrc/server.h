@@ -39,6 +39,7 @@
 
 #include "common.h"
 #include "compressor.h"
+#include "elastic.h"
 #include "postoffice.h"
 
 namespace bps {
@@ -49,6 +50,18 @@ class BytePSServer {
   void Handle(Message&& msg, int fd);  // van-thread entry; enqueues to engine
   void Stop();
   ~BytePSServer() { Stop(); }
+
+  // Elastic worker membership (ISSUE 8; van thread, from the
+  // postoffice's fleet-resize callback). A JOIN pushes a new roster
+  // epoch activating at `join_round`/`join_bcast` — rounds already in
+  // flight keep completing against the old contributor set. A removal
+  // (graceful leave kind 1, death shrink kind 2) erases the id from
+  // every roster and, for a death, enqueues a rollback task per engine
+  // thread: the dead rank's partial contributions are discarded, the
+  // survivors' retained bytes re-summed, and every slot's readiness /
+  // recycle re-evaluated against the shrunk roster.
+  void OnFleetResize(int kind, int affected, int64_t join_round,
+                     int64_t join_bcast);
 
  private:
   // Accumulator for one fused frame's batched reply. subs/data are
@@ -159,6 +172,16 @@ class BytePSServer {
     int pull_count[2] = {0, 0};
     bool ready[2] = {false, false};
     int round[2] = {-1, -1};
+    // Elastic membership (ISSUE 8; maintained only when BYTEPS_ELASTIC):
+    // per-slot contributor roster + retained decoded contributions (the
+    // death-shrink rollback's rebuild source — freed at round ready).
+    ElasticSlot er[2];
+    // Contributor count of the round a slot serves / last served: the
+    // worker-side mean divisor, carried on every sync PULL_RESP's arg1
+    // so a pull issued before a membership change still divides by the
+    // round's ACTUAL roster size. Mirrors round[]/last_round[].
+    int contrib_n[2] = {0, 0};
+    int last_contrib_n[2] = {0, 0};
     std::vector<EngineTask> pending_pulls[2];
     std::vector<EngineTask> parked_pushes[2];
     // async mode: server-resident value
@@ -176,6 +199,10 @@ class BytePSServer {
     struct BcastRound {
       std::vector<char> data;
       int served = 0;
+      // Expected non-root pulls, FROZEN at push time from the round's
+      // roster: a bcast pushed before a join must not wait for the
+      // joiner, and one pushed after expects it (ISSUE 8).
+      int waiters = 0;
     };
     std::unordered_map<int, BcastRound> bcast_rounds;
     std::vector<std::pair<int, MsgHeader>> pending_bcast_pulls;
@@ -230,6 +257,32 @@ class BytePSServer {
   // Encode one round's aggregate into qreply[slot] (quant-eligible keys
   // only; called at round-ready, exactly like the comp_reply encode).
   void EncodeQuantReply(KeyStore* ks, int slot);
+
+  // The round is complete (every expected contributor summed): seal the
+  // contribution roster, encode the cached replies, release this
+  // round's pending pulls, and replay parked pushes when a pull
+  // recycled the slot. Shared by the push path and the shrink rollback.
+  void RoundReady(KeyStore* ks, int slot);
+  // Expected contributor count for round `version` of a sync key: the
+  // roster size when elastic, the fixed fleet size otherwise.
+  int ExpectedContributors(int64_t version);
+  // True when round `version`'s contributor set is complete. The
+  // elastic check is EXACT set equality against the round's roster —
+  // see ElasticSlot::PushersMatch for why superset would be unsound
+  // during a shrink.
+  bool RoundComplete(KeyStore* ks, int slot, int64_t version);
+  // True when every roster member pulled round `version` (recycle).
+  bool RoundServed(KeyStore* ks, int slot, int64_t version);
+  // Death-shrink rollback for this engine thread's keys (tid-owned):
+  // discard `dead`'s partial contributions, rebuild sums from the
+  // survivors' retained bytes, drop its parked/pending ops, and
+  // re-evaluate every slot against the shrunk roster.
+  void ShrinkWorker(int tid, int dead);
+
+  // Elastic state: armed flag + the fleet's per-epoch contributor
+  // roster history (activation-round keyed; see elastic.h).
+  bool elastic_ = false;
+  RosterHistory roster_;
 
   Postoffice* po_ = nullptr;
   bool async_ = false;

@@ -2,6 +2,7 @@
 
 #include <sys/uio.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstring>
 #include <map>
@@ -175,6 +176,67 @@ void BytePSWorker::Stop() {
   }
   for (auto& t : rec) {
     if (t.joinable()) t.join();
+  }
+}
+
+// --- elastic worker membership (ISSUE 8) ------------------------------------
+
+void BytePSWorker::OnFleetPause(int kind) {
+  if (kind != 0) return;  // only a JOIN gates new rounds
+  int64_t rmax, bmax;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    fleet_paused_ = true;
+    rmax = sync_round_;
+    bmax = sync_bcast_round_;
+    for (auto& ctx : tensors_) {
+      rmax = std::max(rmax, ctx->round);
+      bmax = std::max(bmax, ctx->bcast_round);
+    }
+  }
+  // Drain-free ack: every round this worker has ISSUED is < the
+  // counters reported here, and those rounds complete against the OLD
+  // roster (the server's per-epoch contributor sets) — so the gate
+  // alone makes the counters final; nothing has to settle first.
+  BPS_LOG(WARNING) << "worker: fleet join in progress — new rounds "
+                      "gated at round " << rmax;
+  po_->SendFleetPauseAck(rmax, bmax);
+}
+
+void BytePSWorker::OnFleetResume(int kind, int64_t join_round,
+                                 int64_t join_bcast) {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (kind == 0) {
+      // Jump every tensor's counters to the join activation round:
+      // each member's NEXT round is the first one the new roster —
+      // joiner included — is expected in. Counters only move forward.
+      sync_round_ = std::max(sync_round_, join_round);
+      sync_bcast_round_ = std::max(sync_bcast_round_, join_bcast);
+      for (auto& ctx : tensors_) {
+        if (ctx->round < sync_round_) ctx->round = sync_round_;
+        if (ctx->bcast_round < sync_bcast_round_) {
+          ctx->bcast_round = sync_bcast_round_;
+        }
+      }
+    }
+    fleet_paused_ = false;
+  }
+  cv_.notify_all();
+}
+
+void BytePSWorker::SyncRounds(int64_t round, int64_t bcast_round) {
+  std::lock_guard<std::mutex> lk(mu_);
+  // Monotone: a later join's RESUME may already have advanced the
+  // counters past this rank's own activation point (two joins racing a
+  // joiner's startup) — counters only ever move forward.
+  sync_round_ = std::max(sync_round_, round);
+  sync_bcast_round_ = std::max(sync_bcast_round_, bcast_round);
+  for (auto& ctx : tensors_) {
+    if (ctx->round < sync_round_) ctx->round = sync_round_;
+    if (ctx->bcast_round < sync_bcast_round_) {
+      ctx->bcast_round = sync_bcast_round_;
+    }
   }
 }
 
@@ -454,6 +516,11 @@ int64_t BytePSWorker::Declare(const std::string& name, int64_t nelem,
   ctx->nelem = nelem;
   ctx->dtype = dtype;
   ctx->priority = -static_cast<int>(ctx->id);  // declaration-order priority
+  // Elastic join (ISSUE 8): a joiner's tensors start at the fleet's
+  // activation round, not 0 — its first push lands exactly in the
+  // first round the new roster expects it in. 0 on ordinary workers.
+  ctx->round = sync_round_;
+  ctx->bcast_round = sync_bcast_round_;
 
   const std::string& comp =
       comp_config == "__default__" ? default_comp_ : comp_config;
@@ -523,6 +590,14 @@ int64_t BytePSWorker::Declare(const std::string& name, int64_t nelem,
 int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
                            int dtype, bool average, bool async_mode) {
   std::unique_lock<std::mutex> lk(mu_);
+  // Elastic membership gate (ISSUE 8): while a JOIN commits, new
+  // rounds wait here so the acked counters stay final. Rounds already
+  // issued are unaffected (they complete against the old roster). The
+  // periodic wake lets a fleet fail-stop (no RESUME will ever come)
+  // fall through instead of wedging at the gate.
+  while (fleet_paused_ && !po_->ShuttingDown()) {
+    cv_.wait_for(lk, std::chrono::milliseconds(100));
+  }
   BPS_CHECK_GE(tensor_id, 0);
   BPS_CHECK(tensor_id < static_cast<int64_t>(tensors_.size()))
       << "undeclared tensor id " << tensor_id;
@@ -552,8 +627,8 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
     // own frames.
     task.fusible = fusion_bytes_ > 0 && task.bytes < fusion_bytes_;
     const int64_t t_enq = NowUs();
-    task.run = [this, ctx, p, ptr, esz, version, scale, async_mode, handle,
-                t_enq] {
+    task.run = [this, ctx, p, ptr, esz, version, scale, average,
+                async_mode, handle, t_enq] {
       // Scheduled-queue wait (credit admission + priority) — the first
       // stage of the per-round breakdown (ISSUE 7).
       RoundStats::Get().Track(RS_QUEUE, version, NowUs() - t_enq);
@@ -569,6 +644,7 @@ int BytePSWorker::PushPull(int64_t tensor_id, void* ptr, int64_t nelem,
       op.flags = async_mode ? FLAG_ASYNC : 0;
       op.version = version;
       op.scale = scale;
+      op.average = average;
       op.handle = handle;
       int64_t t0 = NowUs();
       if (p->comp) {
@@ -642,6 +718,7 @@ void BytePSWorker::SendPush(PushOp op) {
   int flags = op.flags;
   int version = op.version;
   double scale = op.scale;
+  bool average = op.average;
   std::shared_ptr<Handle> handle = op.handle;
   MsgHeader h{};
   h.cmd = CMD_PUSH;
@@ -662,8 +739,8 @@ void BytePSWorker::SendPush(PushOp op) {
   RecTrackPush(p, op);
   int push_rid = kv_->Request(
       p->server_id, h, op.payload, op.payload_len,
-      [this, ctx, p, base, raw_len, version, scale, flags, handle,
-       t_push, plen](Message&& ack) {
+      [this, ctx, p, base, raw_len, version, scale, average, flags,
+       handle, t_push, plen](Message&& ack) {
         if (ack.head.cmd == CMD_ERROR) {
           // Dead server: fail the handle now with the diagnostic
           // instead of blocking Wait until the heartbeat detector.
@@ -713,8 +790,8 @@ void BytePSWorker::SendPush(PushOp op) {
         RoundStats::Get().Track(RS_FRAME, version);
         int pull_rid = kv_->Request(
             p->server_id, ph, nullptr, 0,
-            [this, ctx, p, base, raw_len, version, scale, handle,
-             t_pull, flags, at_push](Message&& resp) {
+            [this, ctx, p, base, raw_len, version, scale, average,
+             handle, t_pull, flags, at_push](Message&& resp) {
               if (resp.head.cmd == CMD_ERROR) {
                 RecClear(p);
                 RoundStats::Get().Track(RS_DONE, version);
@@ -807,8 +884,19 @@ void BytePSWorker::SendPush(PushOp op) {
               // server's slot bytes (the unscaled sum).
               RecTrackDone(p, version, base, raw_len);
               RoundStats::Get().Track(RS_DONE, version);
-              if (scale != 1.0) {
-                CpuReducer::Scale(base, scale, raw_len, ctx->dtype);
+              // Mean divisor: the ROUND's contributor count reported by
+              // the server (arg1) — an elastic membership change
+              // between issue and completion makes the captured fleet
+              // size stale. Same-N fleets produce the identical double
+              // (1/arg1 == the captured 1/num_workers); old servers
+              // send 0 and keep the captured scale.
+              double eff = scale;
+              if (average && !(flags & FLAG_ASYNC) &&
+                  resp.head.arg1 > 0) {
+                eff = 1.0 / static_cast<double>(resp.head.arg1);
+              }
+              if (eff != 1.0) {
+                CpuReducer::Scale(base, eff, raw_len, ctx->dtype);
               }
               queue_->ReleaseCredit(raw_len);
               if (handle->remaining.fetch_sub(1) == 1) {
@@ -1098,8 +1186,15 @@ void BytePSWorker::OnFusedPullResp(
     }
     RecTrackDone(op.p, op.version, op.base, op.raw_len);
     RoundStats::Get().Track(RS_DONE, op.version);
-    if (op.scale != 1.0) {
-      CpuReducer::Scale(op.base, op.scale, op.raw_len, op.ctx->dtype);
+    // Same round-roster mean divisor as the single-frame path: the
+    // batched reply carries each sub-entry's contributor count in its
+    // sub-header arg1.
+    double eff = op.scale;
+    if (op.average && !(op.flags & FLAG_ASYNC) && s.arg1 > 0) {
+      eff = 1.0 / static_cast<double>(s.arg1);
+    }
+    if (eff != 1.0) {
+      CpuReducer::Scale(op.base, eff, op.raw_len, op.ctx->dtype);
     }
     queue_->ReleaseCredit(op.raw_len);
     if (op.handle->remaining.fetch_sub(1) == 1) {
@@ -1125,6 +1220,10 @@ void BytePSWorker::FailBatch(
 int BytePSWorker::Broadcast(int64_t tensor_id, void* ptr, int64_t nelem,
                             int dtype, int root_rank) {
   std::unique_lock<std::mutex> lk(mu_);
+  // Same elastic membership gate as PushPull (ISSUE 8).
+  while (fleet_paused_ && !po_->ShuttingDown()) {
+    cv_.wait_for(lk, std::chrono::milliseconds(100));
+  }
   BPS_CHECK(tensor_id >= 0 &&
             tensor_id < static_cast<int64_t>(tensors_.size()));
   TensorCtx* ctx = tensors_[tensor_id].get();

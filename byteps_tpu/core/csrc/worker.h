@@ -117,6 +117,11 @@ class BytePSWorker {
     int flags = 0;
     int version = 0;
     double scale = 1.0;
+    // Mean requested: the divisor is the ROUND's contributor count
+    // reported on the pull response (arg1), not the fleet size captured
+    // at issue time — an elastic membership change between issue and
+    // completion would otherwise divide by the wrong N (ISSUE 8).
+    bool average = false;
     std::shared_ptr<Handle> handle;
   };
 
@@ -220,6 +225,19 @@ class BytePSWorker {
                  Message&& err);
 
  public:
+  // Elastic worker membership (ISSUE 8; van recv threads). Pause (join
+  // kind): gate new rounds and ack the scheduler with this worker's
+  // round counters — DRAIN-FREE: rounds already issued complete
+  // against the old roster, so the ack only has to freeze the
+  // counters. Resume: sync counters up to the join activation round
+  // (so every member's next round is the first the joiner is expected
+  // in) and lift the gate.
+  void OnFleetPause(int kind);
+  void OnFleetResume(int kind, int64_t join_round, int64_t join_bcast);
+  // Joiner: counters this rank's tensors start at (from the
+  // scheduler's direct ADDRBOOK); applies to future Declares too.
+  void SyncRounds(int64_t round, int64_t bcast_round);
+
   // Hot server replacement (ISSUE 4): the postoffice's peer-recovered
   // callback lands here (van recv thread). Spawns a background thread
   // that re-declares the dead rank's key shard on the replacement,
@@ -263,6 +281,13 @@ class BytePSWorker {
 
   std::mutex mu_;
   std::condition_variable cv_;
+  // Elastic membership gate + counter sync (guarded by mu_): while a
+  // JOIN commits, new PushPull/Broadcast rounds wait at the gate;
+  // sync_round_/sync_bcast_round_ are the counters new declares (and,
+  // on a join's RESUME, existing tensors) start from.
+  bool fleet_paused_ = false;
+  int64_t sync_round_ = 0;
+  int64_t sync_bcast_round_ = 0;
   std::unordered_map<std::string, int64_t> by_name_;
   std::vector<std::unique_ptr<TensorCtx>> tensors_;
   // Cumulative bytes assigned per server (guarded by mu_): drives the

@@ -115,6 +115,15 @@ def _load() -> ctypes.CDLL:
     lib.bps_metrics_observe.restype = ctypes.c_int
     lib.bps_failure_shutdown.argtypes = []
     lib.bps_failure_shutdown.restype = ctypes.c_int
+    # Elastic worker membership (ISSUE 8): live epoch, graceful leave,
+    # and the no-topology epoch-roster/rollback probe.
+    lib.bps_epoch.argtypes = []
+    lib.bps_epoch.restype = ctypes.c_longlong
+    lib.bps_leave.argtypes = []
+    lib.bps_leave.restype = ctypes.c_int
+    lib.bps_elastic_probe.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                      ctypes.c_longlong]
+    lib.bps_elastic_probe.restype = ctypes.c_longlong
     _lib = lib
     return lib
 
@@ -172,6 +181,35 @@ def round_ingest(payload: bytes) -> bool:
     """Ingest serialized heartbeat round-summary wire bytes; False when
     the payload is not a recognized summary (version interop)."""
     return bool(_load().bps_round_ingest(payload, len(payload)))
+
+
+def elastic_probe(script: str) -> dict:
+    """Drive the C core's standalone epoch-roster + rollback bookkeeping
+    (ISSUE 8) through a `;`-separated op script (live:/join:/remove:/
+    push:/pull:/seal/reset/round:) and return the final state — the
+    no-fleet unit-test surface for the elastic membership arithmetic.
+    Raises ValueError on a malformed script."""
+    import json
+
+    lib = _load()
+    size = 1 << 16
+    while True:
+        buf = ctypes.create_string_buffer(size)
+        need = int(lib.bps_elastic_probe(script.encode(), buf, size))
+        if need < 0:
+            raise ValueError(f"malformed elastic probe script {script!r}")
+        if need < size:
+            return json.loads(buf.value.decode())
+        size = need + 1
+
+
+def leave_requested() -> bool:
+    """True when this worker's supervisor asked it to retire (the
+    launcher's elastic scale-down protocol: BYTEPS_RETIRE_FILE names a
+    per-rank file whose existence is the retire signal). Training loops
+    poll this at round boundaries and call Worker.leave()."""
+    path = os.environ.get("BYTEPS_RETIRE_FILE", "")
+    return bool(path) and os.path.exists(path)
 
 
 def metrics_observe(kind: str, name: str, value: int) -> None:
@@ -290,6 +328,11 @@ def _apply_config_env(cfg: Optional[Config]) -> None:
     # never a fleet-wide setting.
     os.environ["BYTEPS_RECOVERY_TIMEOUT_MS"] = str(
         cfg.effective_recovery_timeout_ms)
+    # Elastic worker membership (ISSUE 8). DMLC_JOIN is per-process
+    # identity (the joiner's marker, like DMLC_RECOVER_RANK) and is NOT
+    # projected.
+    os.environ["BYTEPS_ELASTIC"] = "1" if cfg.elastic else "0"
+    os.environ["BYTEPS_ELASTIC_TIMEOUT_MS"] = str(cfg.elastic_timeout_ms)
     os.environ["BYTEPS_CHAOS_SEED"] = str(cfg.chaos_seed)
     os.environ["BYTEPS_CHAOS_DROP"] = str(cfg.chaos_drop)
     os.environ["BYTEPS_CHAOS_DUP"] = str(cfg.chaos_dup)
@@ -406,7 +449,25 @@ class Worker(_Node):
         return self._lib.bps_worker_rank()
 
     def num_workers(self) -> int:
+        """LIVE fleet size: elastic joins/leaves/shrinks move it."""
         return self._lib.bps_num_workers()
+
+    def epoch(self) -> int:
+        """Fleet membership epoch — bumped once per server recovery or
+        worker join/leave/shrink. Poll it between rounds to observe a
+        membership change commit."""
+        return int(self._lib.bps_epoch())
+
+    def leave(self) -> None:
+        """Graceful leave (ISSUE 8): after the caller waited all its
+        handles, drain and tell the scheduler; on return this rank is
+        out of the fleet (call shutdown() and exit — no goodbye owed).
+        Raises RuntimeError when the scheduler never acknowledged
+        (elasticity off, or not a fleet worker)."""
+        if self._lib.bps_leave() != 0:
+            raise RuntimeError(
+                "graceful leave failed: scheduler did not acknowledge "
+                "(is BYTEPS_ELASTIC=1 set fleet-wide?)")
 
     def barrier(self, group: int = GROUP_WORKERS) -> None:
         """Block until every member of `group` arrives. Default is the

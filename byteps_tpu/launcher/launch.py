@@ -95,7 +95,8 @@ def _describe_exit(code: Optional[int]) -> str:
 
 
 def _reap(procs: List[subprocess.Popen], names: Optional[List[str]] = None,
-          respawn=None, supervise: int = 0) -> int:
+          respawn=None, supervise: int = 0, poll_hook=None,
+          worker_death=None) -> int:
     """Wait for all children; on first failure kill the rest.
 
     Mirrors the reference launcher's fail-fast behavior: a dead worker
@@ -106,8 +107,17 @@ def _reap(procs: List[subprocess.Popen], names: Optional[List[str]] = None,
     --supervise mode: ``respawn(name)`` (when given) returns a fresh
     Popen for a dead SERVER role — hot replacement via
     DMLC_RECOVER_RANK — and up to ``supervise`` such respawns replace
-    the fail-fast for server children. Scheduler and worker deaths, and
-    server deaths past the budget, fail fast as before.
+    the fail-fast for server children. Scheduler deaths, and server
+    deaths past the budget, fail fast as before.
+
+    --elastic mode hooks (ISSUE 8): ``poll_hook(remaining)`` runs every
+    loop tick and returns newly spawned children to track (the SIGHUP
+    scale protocol); ``worker_death(name, code)`` decides a dead
+    WORKER's fate — ``"shrink"`` keeps the fleet running (the scheduler
+    retires the rank via the elastic shrink path), ``(new_name, proc)``
+    additionally respawns a fresh joiner, ``None`` falls through to the
+    fail-fast. With both hooks absent the pre-elastic behavior is
+    unchanged: any worker death takes the job down.
     """
     import time
 
@@ -122,6 +132,10 @@ def _reap(procs: List[subprocess.Popen], names: Optional[List[str]] = None,
                 for q in remaining.values():
                     q.kill()
                 term_deadline = None
+            if poll_hook is not None and term_deadline is None:
+                for nname, np_ in (poll_hook(remaining) or {}).items():
+                    procs.append(np_)
+                    remaining[nname] = np_
             for name in list(remaining):
                 p = remaining[name]
                 try:
@@ -146,6 +160,23 @@ def _reap(procs: List[subprocess.Popen], names: Optional[List[str]] = None,
                                   file=sys.stderr, flush=True)
                             procs.append(fresh)
                             remaining[name] = fresh
+                            continue
+                    if (worker_death is not None and term_deadline is None
+                            and name.startswith("worker")):
+                        verdict = worker_death(name, code)
+                        if verdict == "shrink":
+                            print(f"bpslaunch: elastic shrink — fleet "
+                                  f"continues without {name}",
+                                  file=sys.stderr, flush=True)
+                            continue
+                        if verdict is not None:
+                            new_name, fresh = verdict
+                            print(f"bpslaunch: respawning a fresh "
+                                  f"elastic joiner {new_name} "
+                                  f"(pid {fresh.pid}) to replace {name}",
+                                  file=sys.stderr, flush=True)
+                            procs.append(fresh)
+                            remaining[new_name] = fresh
                             continue
                     rc = rc or code
                     if remaining and term_deadline is None:
@@ -183,7 +214,8 @@ def _free_port() -> int:
 
 def launch_local_fleet(command: Sequence[str], num_workers: int,
                        num_servers: int, port: int, env: Dict[str, str],
-                       numa: bool = False, supervise: int = 0) -> int:
+                       numa: bool = False, supervise: int = 0,
+                       elastic: bool = False, scale_file: str = "") -> int:
     """Bring up scheduler + servers + workers on 127.0.0.1 in one call
     (the reference needs tests/run_byteps_test.sh for this topology).
 
@@ -237,13 +269,42 @@ def launch_local_fleet(command: Sequence[str], num_workers: int,
                              env=_role_env(base, "server",
                                            DMLC_WORKER_ID=str(s))))
         names.append(f"server{s}")
+    # Elastic scale protocol (ISSUE 8): SIGHUP makes the launcher read a
+    # target worker count from the scale file — growth spawns fresh
+    # JOINERS (DMLC_JOIN=1; the scheduler allocates never-reused ranks),
+    # shrink touches the highest-index workers' retire files (each
+    # worker's BYTEPS_RETIRE_FILE; training loops poll
+    # ``byteps_tpu.core.ffi.leave_requested()`` and leave gracefully).
+    import tempfile
+
+    state = {"hup": False, "next_idx": num_workers}
+    retire_dir = ""
+    if elastic:
+        base["BYTEPS_ELASTIC"] = "1"
+        retire_dir = tempfile.mkdtemp(prefix="bps_retire_")
+        if not scale_file:
+            scale_file = os.path.join(retire_dir, "bps_scale")
+        signal.signal(signal.SIGHUP,
+                      lambda signum, frame: state.update(hup=True))
+        print(f"bpslaunch: elastic fleet — write a target worker count "
+              f"to {scale_file} and send SIGHUP to pid {os.getpid()} to "
+              f"grow/shrink", file=sys.stderr, flush=True)
+
+    def _spawn_worker(idx: int, join: bool) -> subprocess.Popen:
+        extra = {"DMLC_WORKER_ID": str(idx),
+                 "BYTEPS_LOCAL_RANK": "0",
+                 "BYTEPS_LOCAL_SIZE": "1"}
+        if retire_dir:
+            extra["BYTEPS_RETIRE_FILE"] = os.path.join(
+                retire_dir, f"retire.worker{idx}")
+        if join:
+            extra["DMLC_JOIN"] = "1"
+        e = _role_env(base, "worker", **extra)
+        prefix = _numa_prefix(idx) if numa else []
+        return subprocess.Popen(prefix + list(command), env=e)
+
     for w in range(num_workers):
-        e = _role_env(base, "worker",
-                      DMLC_WORKER_ID=str(w),
-                      BYTEPS_LOCAL_RANK="0",
-                      BYTEPS_LOCAL_SIZE="1")
-        prefix = _numa_prefix(w) if numa else []
-        procs.append(subprocess.Popen(prefix + list(command), env=e))
+        procs.append(_spawn_worker(w, join=False))
         names.append(f"worker{w}")
     # Pid map for operators (and the recovery tests): supervision and
     # post-mortems need to know which pid is which role.
@@ -259,8 +320,56 @@ def launch_local_fleet(command: Sequence[str], num_workers: int,
         e = _role_env(base, "server", DMLC_RECOVER_RANK=str(rank))
         return subprocess.Popen(server_cmd, env=e)
 
+    def _scale_hook(remaining):
+        # Runs on every reap tick; acts only after a SIGHUP.
+        if not state["hup"]:
+            return {}
+        state["hup"] = False
+        try:
+            with open(scale_file) as f:
+                target = int(f.read().strip() or "0")
+        except (OSError, ValueError) as exc:
+            print(f"bpslaunch: SIGHUP but no usable scale file "
+                  f"{scale_file}: {exc}", file=sys.stderr, flush=True)
+            return {}
+        live = sorted(n for n in remaining if n.startswith("worker"))
+        new = {}
+        if target > len(live):
+            for _ in range(target - len(live)):
+                idx = state["next_idx"]
+                state["next_idx"] += 1
+                p2 = _spawn_worker(idx, join=True)
+                print(f"bpslaunch: elastic grow — spawned worker{idx} "
+                      f"pid={p2.pid} as joiner", file=sys.stderr,
+                      flush=True)
+                new[f"worker{idx}"] = p2
+        elif target < len(live) and target >= 1:
+            for name in list(reversed(live))[:len(live) - target]:
+                path = os.path.join(retire_dir, f"retire.{name}")
+                with open(path, "w") as f:
+                    f.write("retire\n")
+                print(f"bpslaunch: elastic shrink — asked {name} to "
+                      f"retire ({path})", file=sys.stderr, flush=True)
+        return new
+
+    worker_budget = {"left": supervise}
+
+    def _worker_death(name: str, code: int):
+        # The scheduler retires the dead rank via the elastic shrink
+        # path either way; with --supervise budget left, additionally
+        # replace the capacity with a fresh joiner (never the old rank —
+        # worker ranks are allocated once and never reused).
+        if worker_budget["left"] > 0:
+            worker_budget["left"] -= 1
+            idx = state["next_idx"]
+            state["next_idx"] += 1
+            return (f"worker{idx}", _spawn_worker(idx, join=True))
+        return "shrink"
+
     return _reap(procs, names, respawn=_respawn_server if supervise else None,
-                 supervise=supervise)
+                 supervise=supervise,
+                 poll_hook=_scale_hook if elastic else None,
+                 worker_death=_worker_death if elastic else None)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -316,6 +425,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "byteps_tpu.monitor.timeline merge --dir DIR` "
                         "(docs/timeline.md). Flight-recorder auto-dumps "
                         "land in the same directory")
+    p.add_argument("--elastic", action="store_true",
+                   help="arm elastic worker membership for the whole "
+                        "fleet (BYTEPS_ELASTIC=1, docs/elasticity.md): "
+                        "workers can join (DMLC_JOIN), leave "
+                        "gracefully, and a dead worker shrinks the "
+                        "fleet to N-1 (scheduler-coordinated rollback) "
+                        "instead of fail-stopping. In --local mode, "
+                        "SIGHUP + the scale file grow/shrink the fleet "
+                        "at runtime, and a dead worker is retired via "
+                        "the shrink path (with --supervise N, a fresh "
+                        "joiner replaces the capacity)")
+    p.add_argument("--scale-file", metavar="PATH", default="",
+                   help="--local --elastic mode: file holding the "
+                        "target worker count, read on SIGHUP (default: "
+                        "a temp path printed at startup)")
     p.add_argument("--supervise", type=int, metavar="N", default=0,
                    help="--local mode: per-child supervision — respawn a "
                         "dead SERVER role (up to N times total) as a hot "
@@ -366,6 +490,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         os.environ["BYTEPS_WIRE_QUANT"] = "1"
     if args.no_roundstats:
         os.environ["BYTEPS_ROUNDSTATS_ON"] = "0"
+    if args.elastic:
+        os.environ["BYTEPS_ELASTIC"] = "1"
     if args.chaos:
         chaos_envs = {"drop": "BYTEPS_CHAOS_DROP",
                       "dup": "BYTEPS_CHAOS_DUP",
@@ -387,7 +513,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         rc = launch_local_fleet(command, args.local, args.num_servers,
                                 args.port, dict(os.environ), numa=args.numa,
-                                supervise=args.supervise)
+                                supervise=args.supervise,
+                                elastic=args.elastic,
+                                scale_file=args.scale_file)
         for attempt in range(args.restarts):
             if rc == 0:
                 break
@@ -405,7 +533,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             rc = launch_local_fleet(command, args.local, args.num_servers,
                                     args.port, dict(os.environ),
                                     numa=args.numa,
-                                    supervise=args.supervise)
+                                    supervise=args.supervise,
+                                    elastic=args.elastic,
+                                    scale_file=args.scale_file)
         return rc
 
     role = os.environ.get("DMLC_ROLE", "worker").lower()

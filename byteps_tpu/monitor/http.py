@@ -37,8 +37,17 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 # round table + per-rank EWMA baselines ingested from
                 # heartbeat summaries. `python -m
                 # byteps_tpu.monitor.insight --watch` polls this.
+                # Elastic membership context (ISSUE 8) rides along so
+                # the insight classifier can call an epoch-change round
+                # `resizing` instead of misreading it as skew.
                 from byteps_tpu.core.ffi import round_summary
-                body = json.dumps(round_summary()).encode()
+                doc = round_summary()
+                gauges = _metrics.snapshot().get("gauges", {})
+                doc["epoch"] = int(gauges.get("bps_membership_epoch", 0))
+                doc["resizing"] = int(gauges.get("bps_fleet_resizing", 0))
+                doc["fleet_workers"] = int(
+                    gauges.get("bps_fleet_workers", 0))
+                body = json.dumps(doc).encode()
                 ctype = "application/json"
                 code = 200
             elif self.path.split("?")[0] == "/healthz":
@@ -73,6 +82,16 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     "recoveries": int(
                         counters.get("bps_recoveries_total", 0)),
                     "epoch": int(gauges.get("bps_membership_epoch", 0)),
+                    # Elastic membership (ISSUE 8): LIVE worker count
+                    # (the node section tracks joins/leaves/shrinks)
+                    # plus the scheduler's change-in-flight flag.
+                    "workers": int(node.get("num_workers", 0)),
+                    "resizing": bool(
+                        gauges.get("bps_fleet_resizing", 0)),
+                    "joins": int(
+                        counters.get("bps_worker_joins_total", 0)),
+                    "leaves": int(
+                        counters.get("bps_worker_leaves_total", 0)),
                     "uptime_s": round(
                         time.monotonic() - self.server.started_at, 3),
                 }).encode()

@@ -81,7 +81,7 @@ REGRESS_FACTOR = 1.5
 REGRESS_MIN_UPDATES = 3
 
 FLEET_STATES = ("healthy", "wire-bound", "sum-bound", "straggler-skewed",
-                "retry-degraded")
+                "retry-degraded", "resizing")
 
 
 def stage_breakdown(rec: dict) -> Dict[str, float]:
@@ -133,11 +133,15 @@ def merge_recs(recs: Iterable[dict]) -> dict:
 
 def classify(workers: Dict[str, dict], straggler_factor: float = 2.0,
              retry_threshold: int = 1,
-             dominance: float = DOMINANCE_SHARE) -> dict:
+             dominance: float = DOMINANCE_SHARE,
+             resizing: bool = False) -> dict:
     """Fleet state from per-worker round records (one record per
     worker — normally each rank's latest completed round).
 
-    Precedence: faults first (``retry-degraded``), then skew
+    Precedence: a membership epoch change in flight (``resizing``)
+    first — a round spanning a join/leave/shrink legitimately stalls
+    some ranks behind the commit and would otherwise read as
+    straggler-skewed — then faults (``retry-degraded``), then skew
     (``straggler-skewed``), then stage dominance (``wire-bound`` /
     ``sum-bound``); anything else is ``healthy``. Skew outranks
     dominance because a paced straggler ALSO inflates wire shares —
@@ -166,7 +170,9 @@ def classify(workers: Dict[str, dict], straggler_factor: float = 2.0,
         n for n, m in push_means.items()
         if m >= PUSH_FLOOR_US and m > straggler_factor * baseline)
 
-    if retries >= retry_threshold:
+    if resizing:
+        state = "resizing"
+    elif retries >= retry_threshold:
         state = "retry-degraded"
     elif stragglers:
         state = "straggler-skewed"
@@ -236,6 +242,11 @@ def hints(state: str, fleet_rec: dict) -> List[str]:
             "resends are burning round time -> inspect link loss; if "
             "rounds are healthy-but-slow, raise BYTEPS_RETRY_TIMEOUT_MS "
             "so the timer stops re-sending live requests")
+    elif state == "resizing":
+        out.append(
+            "a worker membership epoch change is committing -> "
+            "transient; re-check once bps_fleet_resizing drops to 0 "
+            "(stuck past BYTEPS_ELASTIC_TIMEOUT_MS would fail-stop)")
     if bd["queue"] / wall >= DOMINANCE_SHARE:
         out.append(
             "scheduled-queue wait dominates the wall -> raise "
@@ -268,7 +279,8 @@ def analyze(summary: dict, straggler_factor: float = 2.0,
         last = summary.get("last")
         workers = {str(summary.get("node_id", -1)): last} if last else {}
         local_only = True
-    rep = classify(workers, straggler_factor=straggler_factor)
+    rep = classify(workers, straggler_factor=straggler_factor,
+                   resizing=bool(summary.get("resizing", 0)))
     rep["regressions"] = regressions(
         {n: st for n, st in fleet.items() if st.get("role") == 2},
         factor=regress_factor)

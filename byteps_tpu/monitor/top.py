@@ -163,6 +163,9 @@ def analyze(scrapes: Dict[str, Optional[dict]],
     epoch = 0
     recovering = any(w.get("recovering") for w in workers.values())
     recoveries = 0
+    fleet_workers = 0
+    resizing = False
+    joins = leaves = 0
     sched = scrapes.get("scheduler")
     if sched:
         for labels in sched.get("bps_node_dead", {}):
@@ -177,6 +180,12 @@ def analyze(scrapes: Dict[str, Optional[dict]],
         epoch = int(_sample(sched, "bps_membership_epoch"))
         recovering = recovering or bool(_sample(sched, "bps_recovering"))
         recoveries = int(_sample(sched, "bps_recoveries_total"))
+        # Elastic worker membership (ISSUE 8): the LIVE fleet size and
+        # whether a join/leave/shrink is committing right now.
+        fleet_workers = int(_sample(sched, "bps_fleet_workers"))
+        resizing = bool(_sample(sched, "bps_fleet_resizing"))
+        joins = int(_sample(sched, "bps_worker_joins_total"))
+        leaves = int(_sample(sched, "bps_worker_leaves_total"))
 
     # Fleet state (ISSUE 7): classify the workers' last-round records
     # with the same rules the /rounds watcher applies.
@@ -187,9 +196,12 @@ def analyze(scrapes: Dict[str, Optional[dict]],
     if round_recs:
         from byteps_tpu.monitor import insight
         rep = insight.classify(round_recs,
-                               straggler_factor=straggler_factor)
+                               straggler_factor=straggler_factor,
+                               resizing=resizing)
         fleet_state = rep["state"]
         fleet_bottleneck = rep["dominant"]
+    elif resizing:
+        fleet_state = "resizing"
 
     return {
         "workers": workers,
@@ -204,6 +216,11 @@ def analyze(scrapes: Dict[str, Optional[dict]],
         "epoch": epoch,
         "recovering": recovering,
         "recoveries": recoveries,
+        # Elastic membership (ISSUE 8; docs/elasticity.md).
+        "fleet_workers": fleet_workers,
+        "resizing": resizing,
+        "joins": joins,
+        "leaves": leaves,
         # Per-round insight (docs/monitoring.md "Round insight").
         "fleet_state": fleet_state,
         "fleet_bottleneck": fleet_bottleneck,
@@ -217,6 +234,14 @@ def _print_report(report: dict, as_json: bool) -> None:
     print(f"{'worker':<10} {'push/s':>8} {'push MB':>9} {'pull MB':>9} "
           f"{'q-ratio':>7} {'mean push':>10} {'queue':>6} {'credit':>14} "
           f"{'rtry':>5} {'reconn':>6} {'BOTTLENECK':>14} flags")
+    if report.get("fleet_workers"):
+        extra = ""
+        if report.get("joins") or report.get("leaves"):
+            extra = (f"; {report.get('joins', 0)} join(s), "
+                     f"{report.get('leaves', 0)} leave(s)")
+        print(f"fleet: {report['fleet_workers']} worker(s)"
+              + (" — RESIZING (membership change committing)"
+                 if report.get("resizing") else "") + extra)
     if report.get("recovering"):
         print(f"fleet: RECOVERING (membership epoch {report['epoch']}; "
               "a server rank is being hot-replaced)")

