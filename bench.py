@@ -224,6 +224,13 @@ def main() -> None:
                         "interleaved paired-ratio methodology as "
                         "--trace-overhead. Writes --out "
                         "(BENCH_insight_r07.json)")
+    p.add_argument("--tenants", action="store_true",
+                   help="multi-tenant QoS bench (ISSUE 9): two "
+                        "concurrent 2-worker jobs (weights 3:1) on one "
+                        "2-server fleet with a paced engine, measuring "
+                        "the per-tenant served-byte split vs the "
+                        "configured weights under sustained contention "
+                        "(BENCH_tenant_r09.json)")
     p.add_argument("--elastic", action="store_true",
                    help="ISSUE 8 artifact: membership epoch-change "
                         "pause time on a live 2wx2s comm-round fleet — "
@@ -252,12 +259,16 @@ def main() -> None:
         return _trace_overhead_worker(args)
     if args.role == "elastic_member_worker":
         return _elastic_member_worker(args)
+    if args.role == "tenant_member_worker":
+        return _tenant_member_worker(args)
     if args.trace_overhead:
         return bench_trace_overhead(args)
     if args.insight_overhead:
         return bench_insight_overhead(args)
     if args.elastic:
         return bench_elastic(args)
+    if args.tenants:
+        return bench_tenants(args)
     if args.sweep:
         args.mfu = True
         if args.repeats is None:
@@ -867,6 +878,205 @@ def _elastic_member_worker(args) -> None:
                       "epoch": w.epoch(),
                       "workers": w.num_workers()}), flush=True)
     w.shutdown()
+
+
+def _tenant_member_worker(args) -> None:
+    """One worker of one tenant's job for bench_tenants: continuous
+    comm rounds of BPS_TENANT_KEYS constant-data tensors, two key
+    groups double-buffered so this tenant's server lane never idles
+    between rounds, until the stop file appears."""
+    import os
+    import time
+
+    import numpy as np
+
+    from byteps_tpu.core import Worker
+
+    stop_file = os.environ.get("BPS_BENCH_STOP_FILE", "")
+    keys = int(os.environ.get("BPS_TENANT_KEYS", "24"))
+    n = int(os.environ.get("BPS_TENANT_N", str(1 << 15)))
+    w = Worker.start()
+    tids = [w.declare(f"tb_{k}", n, "float32", compression="")
+            for k in range(keys)]
+    data = np.ones(n, np.float32)
+    half = max(1, keys // 2)
+    groups = [tids[:half], tids[half:]]
+
+    def issue(g):
+        out = []
+        for tid in groups[g]:
+            arr = data.copy()
+            out.append((arr, w.push_pull(tid, arr, average=True)))
+        return out
+
+    rounds = 0
+    inflight = [issue(0), None]
+    while True:
+        for g in (0, 1):
+            if inflight[g] is None:
+                inflight[g] = issue(g)
+                continue
+            other = 1 - g
+            if inflight[other] is None:
+                inflight[other] = issue(other)
+            for arr, h in inflight[g]:
+                w.wait(h)
+                assert arr[0] == 1.0, arr[0]
+            inflight[g] = None
+            rounds += 1
+        if stop_file and os.path.exists(stop_file):
+            break
+        time.sleep(0)
+    for g in (0, 1):
+        if inflight[g] is not None:
+            for arr, h in inflight[g]:
+                w.wait(h)
+    print(json.dumps({"rounds": rounds,
+                      "tenant": int(os.environ.get("BYTEPS_TENANT_ID",
+                                                   "0"))}),
+          flush=True)
+    w.shutdown()
+
+
+def bench_tenants(args) -> None:
+    """Multi-tenant weighted-split bench (ISSUE 9 artifact): two
+    concurrent 2-worker jobs — tenant 1 weight 3, tenant 2 weight 1 —
+    flood one 2-server fleet whose engine is paced
+    (BYTEPS_SERVER_ENGINE_PACE_MBPS) so both tenants' lanes stay
+    backlogged, and the measured per-tenant DRR-served split over a
+    steady window is compared against the configured 3:1."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    import urllib.request
+
+    from tools.shaped_fleet import free_port
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    td = tempfile.mkdtemp(prefix="bps_tenant_bench_")
+    stop_file = os.path.join(td, "stop")
+    port = free_port()
+    mport = free_port()
+    pace = int(os.environ.get("BPS_TENANT_BENCH_PACE_MBPS", "8"))
+    env = dict(os.environ)
+    env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "4",
+        "DMLC_NUM_SERVER": "2",
+        "BYTEPS_MONITOR_ON": "1",
+        "BYTEPS_MONITOR_PORT": str(mport),
+        "BYTEPS_SERVER_ENGINE_THREAD": "1",
+        "BYTEPS_SERVER_ENGINE_PACE_MBPS": str(pace),
+        "PS_HEARTBEAT_INTERVAL": "1",
+        "BPS_BENCH_STOP_FILE": stop_file,
+        "PYTHONPATH": repo,
+    })
+    procs = []
+    try:
+        for role, count in (("scheduler", 1), ("server", 2)):
+            for _ in range(count):
+                e = dict(env)
+                e["DMLC_ROLE"] = role
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "byteps_tpu.server"], env=e))
+
+        def spawn_member(rank, tenant, weight):
+            e = dict(env)
+            e.update({
+                "DMLC_ROLE": "worker",
+                "DMLC_WORKER_ID": str(rank),
+                "BYTEPS_TENANT_ID": str(tenant),
+                "BYTEPS_TENANT_WEIGHT": str(weight),
+            })
+            return subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--role", "tenant_member_worker"],
+                env=e, stdout=subprocess.PIPE, text=True)
+
+        members = [spawn_member(0, 1, 3), spawn_member(1, 1, 3),
+                   spawn_member(2, 2, 1), spawn_member(3, 2, 1)]
+        procs += members
+
+        def dispatched():
+            out = {}
+            for p in (mport + 1, mport + 2):  # servers are nodes 1, 2
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{p}/tenants", timeout=3) as r:
+                    doc = json.load(r)
+                for tid, st in doc["stats"].items():
+                    out[tid] = out.get(tid, 0) + st["dispatched"]
+            return out
+
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            try:
+                d = dispatched()
+                if d.get("1", 0) > 0 and d.get("2", 0) > 0:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.5)
+        else:
+            raise SystemExit("tenants never both got served")
+        time.sleep(3.0)  # past declare/first-round transients
+        t0 = time.time()
+        d0 = dispatched()
+        time.sleep(float(os.environ.get("BPS_TENANT_BENCH_WINDOW_S",
+                                        "15")))
+        d1 = dispatched()
+        window_s = time.time() - t0
+        with open(stop_file, "w") as f:
+            f.write("stop\n")
+        rounds = {}
+        for wp in members:
+            out, _ = wp.communicate(timeout=120)
+            if wp.returncode != 0:
+                raise SystemExit(f"fleet member failed:\n{out}")
+            for ln in out.splitlines():
+                if ln.startswith("{"):
+                    doc = json.loads(ln)
+                    t = str(doc["tenant"])
+                    rounds[t] = max(rounds.get(t, 0), doc["rounds"])
+        for pr in procs[:3]:
+            pr.wait(timeout=60)
+    finally:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.kill()
+    served = {t: d1[t] - d0[t] for t in ("1", "2")}
+    ratio = served["1"] / served["2"] if served["2"] else float("inf")
+    doc = {
+        "what": ("multi-tenant weighted-fair QoS split (ISSUE 9): two "
+                 "concurrent 2-worker jobs with colliding tids flood "
+                 "one 2w-per-job x 2-server fleet; the engine is paced "
+                 f"to {pace} MB/s per thread so both tenants' lanes "
+                 "stay backlogged, and the DRR-served split over a "
+                 "steady window is measured against the configured "
+                 "weights (served = payload bytes + 1 KiB/op, the "
+                 "bps_tenant_dispatched_total meter)"),
+        "workers_per_tenant": 2,
+        "servers": 2,
+        "weights": {"tenant1": 3, "tenant2": 1},
+        "engine_pace_mbps_per_thread": pace,
+        "summary": {
+            "window_s": round(window_s, 2),
+            "served_bytes_tenant1": served["1"],
+            "served_bytes_tenant2": served["2"],
+            "measured_split": round(ratio, 3),
+            "configured_split": 3.0,
+            "split_error_pct": round(abs(ratio - 3.0) / 3.0 * 100, 1),
+            "rounds_tenant1": rounds.get("1", 0),
+            "rounds_tenant2": rounds.get("2", 0),
+        },
+    }
+    print(json.dumps({"metric": "measured_split", "value": ratio,
+                      "configured": 3.0}))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps({"artifact": args.out}))
 
 
 def bench_elastic(args) -> None:

@@ -190,6 +190,33 @@ class Config:
     #   in formation (set by the launcher's elastic scale-up / a
     #   supervisor respawning a dead worker as a fresh joiner)
 
+    # --- multi-tenant PS (ISSUE 9; docs/multitenancy.md) -------------------
+    tenant_id: Optional[int] = None       # BYTEPS_TENANT_ID
+    #   this JOB's tenant id (u16; every process of one job shares it).
+    #   Unset (None) = the legacy/default tenant: the wire format and
+    #   server engine dispatch are byte-for-byte the pre-tenant ones.
+    #   Set, it namespaces the job's keys server-side as (tenant, key)
+    #   — two jobs with colliding tids can never alias — and enrols the
+    #   job in the weighted-fair engine dispatch
+    tenant_name: str = ""                 # BYTEPS_TENANT_NAME
+    #   display name for /tenants and monitor.top rows (never on the
+    #   wire); defaults to "tenant<ID>"
+    tenant_weight: int = 1                # BYTEPS_TENANT_WEIGHT
+    #   this tenant's fair-share weight: whenever two tenants' engine
+    #   lanes are both backlogged, served bytes converge to the weight
+    #   ratio (deficit round robin; docs/multitenancy.md)
+    tenant_quantum_bytes: int = 65536     # BYTEPS_TENANT_QUANTUM_BYTES
+    #   DRR base quantum: one scheduling visit grants weight x this
+    #   many bytes of service to a tenant's lane
+    tenant_starve_ms: int = 2000          # BYTEPS_TENANT_STARVE_MS
+    #   monitoring threshold: a tenant with queued engine work unserved
+    #   longer than this is flagged STARVED (/tenants + monitor.top)
+    server_engine_pace_mbps: int = 0      # BYTEPS_SERVER_ENGINE_PACE_MBPS
+    #   per-engine-thread service-rate cap (0 = off): ops knob for
+    #   bounding a shared server's CPU burn, and the calibration lever
+    #   the weighted-split QoS tests/bench use to create honest engine
+    #   contention on loopback
+
     # --- chaos injection (deterministic fault harness; BYTEPS_CHAOS_*) -----
     chaos_seed: int = 0                   # BYTEPS_CHAOS_SEED
     chaos_drop: float = 0.0               # BYTEPS_CHAOS_DROP
@@ -376,6 +403,47 @@ class Config:
         if self.reconnect_backoff_ms < 1:
             raise ValueError(
                 "BYTEPS_RECONNECT_BACKOFF_MS must be >= 1")
+        if self.tenant_id is not None and not (0 <= self.tenant_id
+                                               <= 0xFFFF):
+            raise ValueError(
+                f"BYTEPS_TENANT_ID ({self.tenant_id}) must be in "
+                "[0, 65535] — it rides a u16 wire field "
+                "(docs/multitenancy.md)")
+        if not (1 <= self.tenant_weight <= (1 << 20)):
+            raise ValueError(
+                f"BYTEPS_TENANT_WEIGHT ({self.tenant_weight}) must be "
+                "in [1, 2^20]: it scales the engine's DRR quantum "
+                "grant, and a zero weight would never be scheduled")
+        if self.tenant_weight != 1 and self.tenant_id is None:
+            import warnings
+            warnings.warn(
+                "BYTEPS_TENANT_WEIGHT is set but BYTEPS_TENANT_ID is "
+                "not: an unregistered process rides the legacy tenant "
+                "0 pool and its weight is never enrolled — set "
+                "BYTEPS_TENANT_ID on every process of the job",
+                stacklevel=2)
+        if self.tenant_quantum_bytes < 1024:
+            raise ValueError(
+                "BYTEPS_TENANT_QUANTUM_BYTES must be >= 1024 (the DRR "
+                "base quantum; far-below-task-size quanta only add "
+                "scheduling laps, never change the fair share)")
+        if self.tenant_starve_ms < 1:
+            raise ValueError(
+                "BYTEPS_TENANT_STARVE_MS must be >= 1 (the starvation "
+                "flag threshold for /tenants and monitor.top)")
+        if self.server_engine_pace_mbps < 0:
+            raise ValueError(
+                "BYTEPS_SERVER_ENGINE_PACE_MBPS must be >= 0 (0 "
+                "disables the per-engine-thread service-rate cap)")
+        if self.tenant_id is not None and self.tenant_id > 0 \
+                and self.enable_async:
+            import warnings
+            warnings.warn(
+                "BYTEPS_TENANT_ID with BYTEPS_ENABLE_ASYNC: async "
+                "keys are (tenant, key)-namespaced and QoS-scheduled, "
+                "but the async mean divisor stays the fleet-wide "
+                "worker count — use sync mode for multi-job fleets",
+                stacklevel=2)
         if not (0.0 <= self.chaos_drop < 1.0):
             raise ValueError(
                 "BYTEPS_CHAOS_DROP is a probability in [0, 1): dropping "
@@ -557,6 +625,15 @@ def load_config() -> Config:
         elastic=_env_bool("BYTEPS_ELASTIC"),
         elastic_timeout_ms=_env_int("BYTEPS_ELASTIC_TIMEOUT_MS", 30000),
         join_fleet=_env_bool("DMLC_JOIN"),
+        tenant_id=(int(os.environ["BYTEPS_TENANT_ID"])
+                   if os.environ.get("BYTEPS_TENANT_ID") else None),
+        tenant_name=_env_str("BYTEPS_TENANT_NAME", ""),
+        tenant_weight=_env_int("BYTEPS_TENANT_WEIGHT", 1),
+        tenant_quantum_bytes=_env_int("BYTEPS_TENANT_QUANTUM_BYTES",
+                                      65536),
+        tenant_starve_ms=_env_int("BYTEPS_TENANT_STARVE_MS", 2000),
+        server_engine_pace_mbps=_env_int("BYTEPS_SERVER_ENGINE_PACE_MBPS",
+                                         0),
         chaos_seed=_env_int("BYTEPS_CHAOS_SEED", 0),
         chaos_drop=float(os.environ.get("BYTEPS_CHAOS_DROP", "0") or 0),
         chaos_dup=float(os.environ.get("BYTEPS_CHAOS_DUP", "0") or 0),

@@ -19,6 +19,7 @@ LIB_PATH = os.path.join(CORE_DIR, "libbyteps_core.so")
 SOURCES = [
     "debug.cc",
     "trace.cc",
+    "tenancy.cc",
     "roundstats.cc",
     "van.cc",
     "postoffice.cc",

@@ -24,6 +24,7 @@
 #include "postoffice.h"
 #include "roundstats.h"
 #include "server.h"
+#include "tenancy.h"
 #include "trace.h"
 #include "worker.h"
 
@@ -147,8 +148,8 @@ int bps_init(int role) {
     // — a join pushes a new contributor roster, a removal rolls the
     // in-flight rounds back onto the survivors.
     gl->po->SetFleetResizeCallback(
-        [gl](int kind, int affected, int64_t jr, int64_t jb) {
-          gl->server->OnFleetResize(kind, affected, jr, jb);
+        [gl](int kind, int affected, int64_t jr, int64_t jb, int tenant) {
+          gl->server->OnFleetResize(kind, affected, jr, jb, tenant);
         });
   } else if (gl->role == ROLE_WORKER) {
     gl->kv = std::make_unique<KVWorker>(
@@ -592,6 +593,30 @@ long long bps_metrics_snapshot(char* buf, long long maxlen) {
   out += ",\"inflight_bytes\":" + std::to_string(qi);
   out += ",\"credit_budget_bytes\":" + std::to_string(qb) + "}";
 
+  // Multi-tenant section (ISSUE 9): this process's tenant identity,
+  // the per-tenant accounting registry (servers: bytes / ops / queue
+  // depth / sum time / DRR dispatch + starvation age), and — when the
+  // address book is known — the tenant -> (workers, weight) roster.
+  // monitor/metrics.py renders these as bps_tenant_*{tenant="N"}
+  // labeled series; monitor/http.py serves them raw at /tenants.
+  out += ",\"tenants\":{\"local\":{\"id\":" +
+         std::to_string(TenantId());
+  out += ",\"name\":\"" + TenantName() + "\"";
+  out += ",\"weight\":" + std::to_string(TenantWeight()) + "}";
+  out += ",\"stats\":" + Tenancy::Get().SnapshotJson(NowUs());
+  out += ",\"roster\":{";
+  if (po) {
+    bool first = true;
+    for (const auto& kv : po->TenantRoster()) {
+      if (!first) out += ",";
+      first = false;
+      out += "\"" + std::to_string(kv.first) + "\":{\"workers\":" +
+             std::to_string(kv.second.first) +
+             ",\"weight\":" + std::to_string(kv.second.second) + "}";
+    }
+  }
+  out += "}}";
+
   out += ",\"heartbeat_age_ms\":{";
   if (po && gl->role == ROLE_SCHEDULER) {
     bool first = true;
@@ -659,6 +684,186 @@ int bps_round_ingest(const void* data, long long len) {
   if (!data || len <= 0) return 0;
   return RoundStats::Get().Ingest(data, static_cast<size_t>(len)) ? 1
                                                                   : 0;
+}
+
+// This process's tenant id (BYTEPS_TENANT_ID; 0 = legacy/default).
+int bps_tenant_id() { return TenantId(); }
+
+// Multi-tenant snapshot (ISSUE 9): the same "tenants" section
+// bps_metrics_snapshot embeds — local identity, per-tenant accounting,
+// and the address-book roster — as a standalone JSON document for the
+// /tenants monitor endpoint. Same buffer contract as the other
+// snapshot probes.
+long long bps_tenant_summary(char* buf, long long maxlen) {
+  Global* gl = g();
+  Postoffice* po = gl->inited ? gl->po.get() : nullptr;
+  std::string out = "{\"local\":{\"id\":" + std::to_string(TenantId());
+  out += ",\"name\":\"" + TenantName() + "\"";
+  out += ",\"weight\":" + std::to_string(TenantWeight()) + "}";
+  out += ",\"quantum_bytes\":" + std::to_string(TenantQuantum());
+  out += ",\"stats\":" + Tenancy::Get().SnapshotJson(NowUs());
+  out += ",\"roster\":{";
+  if (po) {
+    bool first = true;
+    for (const auto& kv : po->TenantRoster()) {
+      if (!first) out += ",";
+      first = false;
+      out += "\"" + std::to_string(kv.first) + "\":{\"workers\":" +
+             std::to_string(kv.second.first) +
+             ",\"weight\":" + std::to_string(kv.second.second) + "}";
+    }
+  }
+  out += "}}";
+  long long need = static_cast<long long>(out.size());
+  if (buf && maxlen > 0) {
+    long long n = need < maxlen - 1 ? need : maxlen - 1;
+    memcpy(buf, out.data(), static_cast<size_t>(n));
+    buf[n] = '\0';
+  }
+  return need;
+}
+
+// Weighted-DRR / namespacing probe (ISSUE 9; no topology needed):
+// drives one WeightedDrr instance plus the TenantKey arithmetic
+// through a `;`-separated script and writes the final state as JSON
+// (same grow-the-buffer contract as bps_metrics_snapshot). Ops:
+//   quantum:N     set the DRR base quantum (before the first enq)
+//   weight:T=W    set tenant T's weight
+//   enq:T@C       enqueue an item of cost C for tenant T
+//   pop:N         dispatch N items (clamped to what is queued)
+//   key:T@K       append TenantKey(T, K) to "keys"
+//   route:T@K@Q   append TenantKey(T, K) % Q to "routes"
+// Output: {"order":[[tenant,cost],...],"served":{"T":cost_total},
+//          "keys":[...],"routes":[...],"remaining":N} — `order` is the
+// exact dispatch sequence, the contract the fair-share and FIFO unit
+// tests pin down. Returns the JSON length, or -1 on a bad script.
+long long bps_tenant_probe(const char* script, char* buf,
+                           long long maxlen) {
+  if (!script) return -1;
+  int64_t quantum = 0;
+  std::map<uint16_t, int> weights;
+  std::unique_ptr<WeightedDrr> drr;
+  auto ensure = [&]() {
+    if (!drr) {
+      drr = std::make_unique<WeightedDrr>(
+          quantum, [&weights](uint16_t t) {
+            auto it = weights.find(t);
+            return it == weights.end() ? 1 : it->second;
+          });
+    }
+  };
+  std::vector<std::pair<uint16_t, int64_t>> order;
+  std::map<uint16_t, int64_t> served;
+  std::vector<long long> keys, routes;
+  const std::string s(script);
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t end = s.find(';', pos);
+    if (end == std::string::npos) end = s.size();
+    const std::string tok = s.substr(pos, end - pos);
+    pos = end + 1;
+    if (tok.empty()) continue;
+    const size_t colon = tok.find(':');
+    if (colon == std::string::npos) return -1;
+    const std::string op = tok.substr(0, colon);
+    const std::string val = tok.substr(colon + 1);
+    if (op == "quantum") {
+      quantum = atoll(val.c_str());
+    } else if (op == "weight") {
+      const size_t eq = val.find('=');
+      if (eq == std::string::npos) return -1;
+      weights[static_cast<uint16_t>(atoi(val.substr(0, eq).c_str()))] =
+          atoi(val.substr(eq + 1).c_str());
+    } else if (op == "enq") {
+      const size_t at = val.find('@');
+      if (at == std::string::npos) return -1;
+      ensure();
+      drr->Enqueue(
+          static_cast<uint16_t>(atoi(val.substr(0, at).c_str())),
+          atoll(val.substr(at + 1).c_str()));
+    } else if (op == "pop") {
+      ensure();
+      long long n = atoll(val.c_str());
+      while (n-- > 0 && !drr->Empty()) {
+        int64_t cost = 0;
+        const uint16_t t = drr->PickAndPop(&cost);
+        order.emplace_back(t, cost);
+        served[t] += cost;
+      }
+    } else if (op == "key") {
+      const size_t at = val.find('@');
+      if (at == std::string::npos) return -1;
+      keys.push_back(TenantKey(
+          static_cast<uint16_t>(atoi(val.substr(0, at).c_str())),
+          atoll(val.substr(at + 1).c_str())));
+    } else if (op == "route") {
+      const size_t a1 = val.find('@');
+      const size_t a2 = a1 == std::string::npos
+                            ? std::string::npos
+                            : val.find('@', a1 + 1);
+      if (a2 == std::string::npos) return -1;
+      const uint16_t t =
+          static_cast<uint16_t>(atoi(val.substr(0, a1).c_str()));
+      const long long k = atoll(val.substr(a1 + 1, a2 - a1 - 1).c_str());
+      const long long q = atoll(val.substr(a2 + 1).c_str());
+      if (q <= 0) return -1;
+      routes.push_back(static_cast<long long>(
+          static_cast<size_t>(TenantKey(t, k)) %
+          static_cast<size_t>(q)));
+    } else {
+      return -1;
+    }
+  }
+  std::string out = "{\"order\":[";
+  for (size_t i = 0; i < order.size(); ++i) {
+    if (i) out += ",";
+    out += "[" + std::to_string(order[i].first) + "," +
+           std::to_string(order[i].second) + "]";
+  }
+  out += "],\"served\":{";
+  bool first = true;
+  for (const auto& kv : served) {
+    if (!first) out += ",";
+    first = false;
+    out += "\"" + std::to_string(kv.first) +
+           "\":" + std::to_string(kv.second);
+  }
+  out += "},\"keys\":[";
+  for (size_t i = 0; i < keys.size(); ++i) {
+    if (i) out += ",";
+    out += std::to_string(keys[i]);
+  }
+  out += "],\"routes\":[";
+  for (size_t i = 0; i < routes.size(); ++i) {
+    if (i) out += ",";
+    out += std::to_string(routes[i]);
+  }
+  out += "],\"remaining\":" +
+         std::to_string(drr ? static_cast<long long>(drr->Size()) : 0);
+  out += "}";
+  const long long need = static_cast<long long>(out.size());
+  if (buf && maxlen > 0) {
+    long long n = need < maxlen - 1 ? need : maxlen - 1;
+    memcpy(buf, out.data(), static_cast<size_t>(n));
+    buf[n] = '\0';
+  }
+  return need;
+}
+
+// Wire-layout pin for the A/B byte-identity test (ISSUE 9): serialize
+// a MsgHeader with the given cmd/tenant/key/version into `buf` (which
+// must hold sizeof(MsgHeader) = 64 bytes) and return its size. A
+// tenant-0 header must be byte-for-byte the pre-tenant layout — the
+// Python test asserts it against a struct.pack reference.
+int bps_wire_header_probe(int cmd, int tenant, long long key,
+                          int version, void* buf) {
+  MsgHeader h{};
+  h.cmd = static_cast<int16_t>(cmd);
+  h.tenant = static_cast<uint16_t>(tenant);
+  h.key = key;
+  h.version = version;
+  if (buf) memcpy(buf, &h, sizeof(h));
+  return static_cast<int>(sizeof(h));
 }
 
 // Record into the registry from outside the C core: kind is "counter"

@@ -201,7 +201,15 @@ enum MsgFlags : int32_t {
 
 #pragma pack(push, 1)
 struct MsgHeader {
-  int32_t cmd = 0;
+  // Carved out of the old i32 cmd (ISSUE 9, multi-tenant namespaces):
+  // command values never exceeded 31, so the high two bytes were always
+  // zero on the wire — they now carry the sender's tenant id. A frame
+  // from a pre-tenant peer (or any BYTEPS_TENANT_ID-unset process)
+  // reads back as tenant 0, and a tenant-0 frame is byte-for-byte the
+  // pre-tenant header: cmd's little-endian bytes [lo, hi] followed by
+  // tenant [0, 0] reproduce the old 4-byte cmd exactly.
+  int16_t cmd = 0;
+  uint16_t tenant = 0;     // sender's tenant id (0 = legacy/default)
   int32_t sender = -1;     // node id (-1 before registration)
   int64_t key = 0;         // partition key
   int32_t req_id = -1;     // request id for matching responses
@@ -245,7 +253,14 @@ struct SubHeader {
   // wire_dtype [0, 0] reproduce the old 4-byte cmd exactly.
   int16_t wire_dtype = 0;
   int32_t version = 0;
-  int32_t dtype = 0;
+  // Carved out of the old i32 dtype exactly like the frame header's cmd
+  // (ISSUE 9): dtype values never exceed 7, so the high bytes were
+  // always zero — they now carry the sub-operation's tenant id (every
+  // sub-op of one frame shares the frame's tenant; the field makes each
+  // table entry self-describing for the engine fan-out). Tenant-0
+  // tables stay byte-for-byte the pre-tenant layout.
+  int16_t dtype = 0;
+  uint16_t tenant = 0;
   int32_t flags = 0;
   int64_t arg0 = 0;
   int64_t arg1 = 0;
@@ -317,7 +332,26 @@ struct NodeInfo {
   int32_t role;
   char host[64];
   int32_t port;
+  // Multi-tenant roster (ISSUE 9): the tenant this node serves traffic
+  // for (workers; servers/scheduler are shared infrastructure, 0) and
+  // its job's BYTEPS_TENANT_WEIGHT share, registered at CMD_REGISTER /
+  // CMD_JOIN_REQUEST time and broadcast to every rank in the address
+  // book — servers derive per-tenant expected-contributor counts and
+  // DRR weights from the book alone, with no extra control messages.
+  // Zero-initialised by every pre-existing construction site, so a
+  // tenant-less fleet's book carries (0, 0) = the legacy pool.
+  int32_t tenant = 0;
+  int32_t weight = 0;  // 0 reads as weight 1 (legacy registrants)
 };
 #pragma pack(pop)
+
+// Wire-layout pins (ISSUE 9 A/B contract): the tenant fields are carved
+// from bytes that were provably always zero, so the header/sub-header
+// sizes — and therefore every data-plane frame with tenant 0 — are
+// byte-for-byte the pre-tenant wire. NodeInfo (control-plane address
+// book, same-binary fleet) is the one struct that legitimately grew.
+static_assert(sizeof(MsgHeader) == 64, "MsgHeader wire size changed");
+static_assert(sizeof(SubHeader) == 56, "SubHeader wire size changed");
+static_assert(sizeof(NodeInfo) == 84, "NodeInfo wire size changed");
 
 }  // namespace bps

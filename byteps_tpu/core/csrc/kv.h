@@ -24,6 +24,7 @@
 #include "metrics.h"
 #include "postoffice.h"
 #include "roundstats.h"
+#include "tenancy.h"
 #include "trace.h"
 
 namespace bps {
@@ -119,6 +120,11 @@ class KVWorker {
     bool dead;
     const bool retry_on = retry_max_ > 0;
     head.sender = po_->my_id();
+    // Tenant stamp (ISSUE 9): every request this process sends carries
+    // its BYTEPS_TENANT_ID — the server's (tenant, key) namespace and
+    // per-tenant QoS key on it. Unset/legacy processes stamp 0, which
+    // is byte-for-byte the pre-tenant header.
+    head.tenant = TenantId();
     {
       std::lock_guard<std::mutex> lk(mu_);
       rid = next_req_id_++;

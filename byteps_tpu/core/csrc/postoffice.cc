@@ -9,6 +9,7 @@
 #include "logging.h"
 #include "metrics.h"
 #include "roundstats.h"
+#include "tenancy.h"
 #include "trace.h"
 
 namespace bps {
@@ -208,6 +209,14 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
     NodeInfo me{};
     me.id = -1;
     me.role = role;
+    // Tenant registration (ISSUE 9): workers advertise their job's
+    // tenant id + weight; the scheduler re-broadcasts them with every
+    // address book. Servers/scheduler are shared infrastructure
+    // (tenant 0, weight 0 — the zero-initialised legacy bytes).
+    if (role == ROLE_WORKER && TenantId() > 0) {
+      me.tenant = TenantId();
+      me.weight = TenantWeight();
+    }
     const char* host_env = getenv("DMLC_NODE_HOST");
     snprintf(me.host, sizeof(me.host), "%s",
              host_env && *host_env ? host_env : "127.0.0.1");
@@ -217,6 +226,7 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
     }
     MsgHeader h{};
     h.cmd = CMD_REGISTER;
+    h.tenant = TenantId();
     h.sender = -1;
     const char* wid = getenv("DMLC_WORKER_ID");
     h.arg0 = wid && *wid ? atol(wid) : -1;  // preferred rank (deterministic)
@@ -302,6 +312,7 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
     Metrics::Get().Counter("bps_worker_joins_total");
     Metrics::Get().Counter("bps_worker_leaves_total");
     Metrics::Get().Gauge("bps_fleet_workers");
+    Metrics::Get().Gauge("bps_fleet_tenants");
     Metrics::Get().Gauge("bps_fleet_resizing");
     Metrics::Get().Gauge("bps_epoch_change_ms");
     BPS_METRIC_GAUGE_SET("bps_fleet_workers", num_workers_.load());
@@ -368,6 +379,7 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
           MemberOp op;
           op.kind = 2;
           op.node_id = dead[0];
+          op.tenant = TenantOfNodeLocked(dead[0]);
           member_queue_.push_back(std::move(op));
           if (!member_active_) {
             MemberOp next = std::move(member_queue_.front());
@@ -454,6 +466,26 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
           // joined workers get fresh, never-reused ranks/ids.
           next_worker_rank_ = next_worker;
           cv_.notify_all();
+          // Tenant roster (ISSUE 9): feed node->tenant into the
+          // round-summary layer (insight tags rounds by tenant) and
+          // log the per-tenant split when any registrant named one.
+          std::map<int, int> by_tenant;
+          for (const auto& n : nodes_) {
+            if (n.role != ROLE_WORKER) continue;
+            RoundStats::Get().SetNodeTenant(n.id, n.tenant);
+            ++by_tenant[n.tenant];
+          }
+          BPS_METRIC_GAUGE_SET("bps_fleet_tenants",
+                               static_cast<int64_t>(by_tenant.size()));
+          if (by_tenant.size() > 1 || by_tenant.count(0) == 0) {
+            std::string roster;
+            for (const auto& kv : by_tenant) {
+              roster += " tenant " + std::to_string(kv.first) + ": " +
+                        std::to_string(kv.second) + " worker(s);";
+            }
+            BPS_LOG(WARNING) << "scheduler: multi-tenant fleet —"
+                             << roster;
+          }
           BPS_LOG(INFO) << "scheduler: topology complete ("
                         << num_workers_.load() << " workers, "
                         << num_servers_ << " servers)";
@@ -686,8 +718,17 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
       BPS_LOG(WARNING) << "node " << my_id_ << ": epoch "
                        << msg.head.arg0 << " FLEET_PAUSE — worker "
                        << (kind == 0 ? "joining" :
-                           kind == 1 ? "leaving" : "death shrink");
-      if (role_ == ROLE_WORKER && kind == 0 && fleet_pause_cb_) {
+                           kind == 1 ? "leaving" : "death shrink")
+                       << (msg.head.tenant
+                               ? " (tenant " +
+                                     std::to_string(msg.head.tenant) + ")"
+                               : "");
+      // Tenant-scoped gate (ISSUE 9): rounds are per-tenant counters,
+      // so only the JOINING tenant's workers gate and ack — another
+      // tenant's rounds proceed untouched through the epoch change
+      // (the scheduler only waits for the affected tenant's acks).
+      if (role_ == ROLE_WORKER && kind == 0 && fleet_pause_cb_ &&
+          msg.head.tenant == TenantId()) {
         fleet_pause_cb_(kind);
       }
       break;
@@ -739,9 +780,14 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
                         kind);
       Trace::Get().FlightDumpAuto("fleet_resume");
       if (role_ == ROLE_SERVER && fleet_resize_cb_) {
-        fleet_resize_cb_(kind, affected, jr, jb);
+        fleet_resize_cb_(kind, affected, jr, jb, msg.head.tenant);
       }
-      if (role_ == ROLE_WORKER && fleet_resume_cb_) {
+      // A join's counter sync is tenant-scoped (ISSUE 9): the packed
+      // activation round is in the JOINING tenant's round space, and
+      // other tenants' workers never gated — jumping their counters
+      // would corrupt their (independent) round numbering.
+      if (role_ == ROLE_WORKER && fleet_resume_cb_ &&
+          (kind != 0 || msg.head.tenant == TenantId())) {
         fleet_resume_cb_(kind, affected, jr, jb);
       }
       break;
@@ -1042,6 +1088,7 @@ void Postoffice::HandleJoinRequest(Message&& msg, int fd) {
   op.kind = 0;
   op.fd = fd;
   memcpy(&op.info, msg.payload.data(), sizeof(NodeInfo));
+  op.tenant = op.info.tenant;  // tenant-scoped gate + roster epoch
   std::lock_guard<std::mutex> lk(mu_);
   if (!addrbook_ready_) {
     BPS_LOG(WARNING) << "scheduler: join request before fleet formation "
@@ -1095,12 +1142,76 @@ void Postoffice::HandleLeaveRequest(const Message& msg, int fd) {
   MemberOp op;
   op.kind = 1;
   op.node_id = id;
+  op.tenant = TenantOfNodeLocked(id);
   member_queue_.push_back(std::move(op));
   if (!member_active_) {
     MemberOp next = std::move(member_queue_.front());
     member_queue_.pop_front();
     StartMemberOpLocked(std::move(next));
   }
+}
+
+int Postoffice::TenantOfNodeLocked(int node_id) const {
+  for (const auto& n : nodes_) {
+    if (n.id == node_id) return n.tenant;
+  }
+  return 0;
+}
+
+std::set<int> Postoffice::TenantWorkers(uint16_t tenant) {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::set<int> out;
+  for (const auto& n : nodes_) {
+    if (n.role == ROLE_WORKER &&
+        static_cast<uint16_t>(n.tenant) == tenant) {
+      out.insert(n.id);
+    }
+  }
+  return out;
+}
+
+int Postoffice::TenantWorkerCount(uint16_t tenant) {
+  std::lock_guard<std::mutex> lk(mu_);
+  int count = 0;
+  for (const auto& n : nodes_) {
+    if (n.role == ROLE_WORKER &&
+        static_cast<uint16_t>(n.tenant) == tenant) {
+      ++count;
+    }
+  }
+  return count;
+}
+
+int Postoffice::TenantWeightOf(uint16_t tenant) {
+  std::lock_guard<std::mutex> lk(mu_);
+  int w = 0;
+  for (const auto& n : nodes_) {
+    if (n.role == ROLE_WORKER &&
+        static_cast<uint16_t>(n.tenant) == tenant) {
+      w = std::max(w, n.weight);
+    }
+  }
+  return w > 0 ? w : 1;
+}
+
+int Postoffice::TenantOfNode(int node_id) {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (const auto& n : nodes_) {
+    if (n.id == node_id) return n.tenant;
+  }
+  return -1;
+}
+
+std::map<uint16_t, std::pair<int, int>> Postoffice::TenantRoster() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::map<uint16_t, std::pair<int, int>> out;
+  for (const auto& n : nodes_) {
+    if (n.role != ROLE_WORKER) continue;
+    auto& e = out[static_cast<uint16_t>(n.tenant)];
+    ++e.first;
+    e.second = std::max(e.second, n.weight > 0 ? n.weight : 1);
+  }
+  return out;
 }
 
 void Postoffice::StartMemberOpLocked(MemberOp&& op) {
@@ -1126,6 +1237,10 @@ void Postoffice::StartMemberOpLocked(MemberOp&& op) {
                        : " of node " + std::to_string(member_op_.node_id));
   MsgHeader h{};
   h.cmd = CMD_FLEET_PAUSE;
+  // Tenant-scoped change (ISSUE 9): every rank sees the epoch bump,
+  // but only the affected tenant's workers gate rounds and ack — round
+  // counters are per-tenant, so another tenant's training is untouched.
+  h.tenant = static_cast<uint16_t>(member_op_.tenant);
   h.sender = kSchedulerId;
   h.arg0 = epoch_.load();
   h.version = member_op_.kind;
@@ -1134,7 +1249,8 @@ void Postoffice::StartMemberOpLocked(MemberOp&& op) {
     if (n.id == kSchedulerId || n.id == member_op_.node_id) continue;
     auto it = node_fd_.find(n.id);
     if (it != node_fd_.end()) van_->Send(it->second, h);
-    if (member_op_.kind == 0 && n.role == ROLE_WORKER) {
+    if (member_op_.kind == 0 && n.role == ROLE_WORKER &&
+        n.tenant == member_op_.tenant) {
       pause_acks_pending_.insert(n.id);
     }
   }
@@ -1164,6 +1280,7 @@ void Postoffice::CompleteMemberOpLocked() {
     last_heartbeat_ms_[id] = NowMs();
     num_workers_.fetch_add(1);
     op.node_id = id;
+    RoundStats::Get().SetNodeTenant(id, adopted.tenant);
     BPS_METRIC_COUNTER_ADD("bps_worker_joins_total", 1);
     // The joiner's direct ADDRBOOK: assigned id + the round boundary
     // it enters at (every existing worker's counters were gated at or
@@ -1205,8 +1322,19 @@ void Postoffice::CompleteMemberOpLocked() {
   Trace::Get().Note("FLEET_RESUME", epoch_.load(), op.node_id, -1,
                     op.kind);
   Trace::Get().FlightDumpAuto("fleet_resume");
+  {
+    // Live tenant-count gauge (a tenant appears with its first worker
+    // and disappears with its last).
+    std::map<int, int> by_tenant;
+    for (const auto& n : nodes_) {
+      if (n.role == ROLE_WORKER) ++by_tenant[n.tenant];
+    }
+    BPS_METRIC_GAUGE_SET("bps_fleet_tenants",
+                         static_cast<int64_t>(by_tenant.size()));
+  }
   MsgHeader rs{};
   rs.cmd = CMD_FLEET_RESUME;
+  rs.tenant = static_cast<uint16_t>(op.tenant);
   rs.sender = kSchedulerId;
   rs.arg0 = epoch_.load();
   rs.version = op.kind;

@@ -106,9 +106,12 @@ class Postoffice {
                          int64_t join_bcast)> cb) {
     fleet_resume_cb_ = std::move(cb);
   }
+  // Server-side resize additionally carries the affected node's TENANT
+  // (ISSUE 9): rounds are per-tenant counters, so the roster epoch a
+  // join/removal creates must land in that tenant's history only.
   void SetFleetResizeCallback(
       std::function<void(int kind, int affected, int64_t join_round,
-                         int64_t join_bcast)> cb) {
+                         int64_t join_bcast, int tenant)> cb) {
     fleet_resize_cb_ = std::move(cb);
   }
 
@@ -167,6 +170,23 @@ class Postoffice {
   // fleet merge (monitor.timeline) aligns per-rank clocks.
   int64_t ClockOffsetUs() const { return clock_offset_us_.load(); }
   int64_t ClockRttUs() const { return clock_rtt_us_.load(); }
+  // --- multi-tenant roster (ISSUE 9), derived from the address book ---
+  // Worker ids serving tenant `tenant`. Tenant registration rides
+  // NodeInfo (CMD_REGISTER / CMD_JOIN_REQUEST payloads) and is
+  // re-broadcast with every address book, so the roster is live across
+  // elastic membership changes with no extra control traffic. Empty
+  // when the book has not arrived yet (callers fall back to the
+  // formation fleet size for tenant 0).
+  std::set<int> TenantWorkers(uint16_t tenant);
+  int TenantWorkerCount(uint16_t tenant);
+  // The tenant's advertised BYTEPS_TENANT_WEIGHT share (max across its
+  // workers; 0-weight legacy registrants read as 1).
+  int TenantWeightOf(uint16_t tenant);
+  // Tenant of a worker node id (-1 = unknown node).
+  int TenantOfNode(int node_id);
+  // Full roster: tenant -> (live worker count, weight).
+  std::map<uint16_t, std::pair<int, int>> TenantRoster();
+
   // Worker/server ids the scheduler considers dead (missed heartbeats).
   std::vector<int> DeadNodes();
   // Scheduler-side heartbeat freshness: (node id, ms since last beat)
@@ -188,7 +208,13 @@ class Postoffice {
     int fd = -1;       // joiner's scheduler connection
     NodeInfo info{};   // joiner's advertised address
     int node_id = -1;  // leaver / dead worker id
+    // Tenant of the joining/departing worker (ISSUE 9): only THIS
+    // tenant's workers gate (join) and only this tenant's rosters
+    // move — another tenant's rounds are untouched by the change.
+    int tenant = 0;
   };
+  // Tenant of a node id from the current book; caller holds mu_.
+  int TenantOfNodeLocked(int node_id) const;
   void StartMemberOpLocked(MemberOp&& op);
   void CompleteMemberOpLocked();
   void HandleJoinRequest(Message&& msg, int fd);
@@ -256,7 +282,7 @@ class Postoffice {
   std::function<void(int)> peer_recovered_cb_;
   std::function<void(int)> fleet_pause_cb_;
   std::function<void(int, int, int64_t, int64_t)> fleet_resume_cb_;
-  std::function<void(int, int, int64_t, int64_t)> fleet_resize_cb_;
+  std::function<void(int, int, int64_t, int64_t, int)> fleet_resize_cb_;
 
   // Hot-server-replacement state (guarded by mu_ unless atomic).
   std::atomic<int64_t> epoch_{0};          // fleet membership epoch

@@ -4,6 +4,7 @@
 #include <cstring>
 
 #include "metrics.h"
+#include "tenancy.h"
 
 namespace bps {
 
@@ -69,6 +70,11 @@ RoundStats& RoundStats::Get() {
 void RoundStats::SetNode(int role, int node_id) {
   role_.store(role, std::memory_order_relaxed);
   node_id_.store(node_id, std::memory_order_relaxed);
+}
+
+void RoundStats::SetNodeTenant(int node_id, int tenant) {
+  std::lock_guard<std::mutex> lk(mu_);
+  node_tenant_[node_id] = tenant;
 }
 
 void RoundStats::Track(int32_t stage, int round, int64_t us,
@@ -264,6 +270,7 @@ std::string RoundStats::SnapshotJson() {
          std::to_string(role_.load(std::memory_order_relaxed));
   out += ",\"node_id\":" +
          std::to_string(node_id_.load(std::memory_order_relaxed));
+  out += ",\"tenant\":" + std::to_string(TenantId());
   out += ",\"ring_capacity\":" + std::to_string(ring_cap_);
   out += ",\"completed_total\":" + std::to_string(ring_total_);
   int64_t over = ring_total_ - static_cast<int64_t>(ring_cap_);
@@ -292,6 +299,9 @@ std::string RoundStats::SnapshotJson() {
     first = false;
     out += "\"" + std::to_string(kv.first) + "\":{";
     out += "\"role\":" + std::to_string(kv.second.role);
+    auto tit = node_tenant_.find(kv.first);
+    out += ",\"tenant\":" +
+           std::to_string(tit == node_tenant_.end() ? 0 : tit->second);
     out += ",\"completed_total\":" +
            std::to_string(kv.second.completed_total);
     out += ",\"updates\":" + std::to_string(kv.second.updates);

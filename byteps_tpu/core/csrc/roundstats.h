@@ -111,6 +111,12 @@ class RoundStats {
   bool On() const { return armed_.load(std::memory_order_relaxed); }
   void SetNode(int role, int node_id);
 
+  // Tenant tag for a fleet rank (ISSUE 9): the scheduler feeds its
+  // address-book node->tenant mapping here so fleet round summaries —
+  // and therefore insight's classifier — can name the noisy neighbor
+  // by tenant. Local snapshots tag with the process's own TenantId().
+  void SetNodeTenant(int node_id, int tenant);
+
   // The one accumulation entry point (no-op unless On()). `round` < 0
   // is ignored — broadcast traffic and pre-round ops carry no round.
   void Track(int32_t stage, int round, int64_t us = 0, int64_t bytes = 0);
@@ -179,6 +185,10 @@ class RoundStats {
   bool heartbeat_summary_on_ = true;
   std::map<int, RankState> fleet_;
   std::map<int, std::map<int, RoundRec>> fleet_rounds_;
+  // node id -> tenant (scheduler, fed from the address book). The
+  // heartbeat wire stays byte-identical — tenant identity is control-
+  // plane state the scheduler already holds.
+  std::map<int, int> node_tenant_;
 
  public:
   bool HeartbeatSummaryOn() const { return heartbeat_summary_on_; }

@@ -1,6 +1,7 @@
 #include "server.h"
 
 #include <sys/uio.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <cstring>
@@ -39,8 +40,10 @@ void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
   }
   // Elastic worker membership (ISSUE 8): arm the per-epoch contributor
   // rosters. Start runs before the postoffice forms the fleet, so the
-  // initial roster comes from the formation env (worker ids 1+S..S+W —
-  // the postoffice id layout); membership changes arrive later through
+  // initial TENANT-0 roster comes from the formation env (worker ids
+  // 1+S..S+W — the postoffice id layout; byte-for-byte the pre-tenant
+  // arming). Other tenants' histories initialise lazily from the
+  // address book (RosterOf); membership changes arrive later through
   // OnFleetResize.
   if (const char* ev = getenv("BYTEPS_ELASTIC")) {
     elastic_ = atoi(ev) != 0;
@@ -51,9 +54,22 @@ void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
     if (const char* v = getenv("DMLC_NUM_SERVER")) ns = atoi(v);
     std::set<int> live;
     for (int w = 0; w < nw; ++w) live.insert(1 + ns + w);
-    roster_.Init(live);
+    {
+      std::lock_guard<std::mutex> lk(roster_mu_);
+      auto& r = rosters_[0];
+      r = std::make_unique<RosterHistory>();
+      r->Init(live);
+    }
     BPS_LOG(INFO) << "server: elastic worker membership armed ("
                   << nw << " initial worker(s))";
+  }
+  if (const char* pv = getenv("BYTEPS_SERVER_ENGINE_PACE_MBPS")) {
+    const long mbps = atol(pv);
+    if (mbps > 0) {
+      engine_pace_bps_ = static_cast<int64_t>(mbps) * 1000 * 1000;
+      BPS_LOG(WARNING) << "server: engine service pacing armed ("
+                       << mbps << " MB/s per engine thread)";
+    }
   }
   const char* rr = getenv("DMLC_RECOVER_RANK");
   recover_mode_.store(rr && *rr);
@@ -95,8 +111,14 @@ void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
     Metrics::Get().Gauge(g);
   }
   queues_.clear();
+  // DRR weights resolve through the address book at grant time (ISSUE
+  // 9): a tenant's BYTEPS_TENANT_WEIGHT rides its workers' NodeInfo
+  // registrations, so weights stay live across elastic membership
+  // changes with no extra control traffic.
   for (int i = 0; i < engine_threads; ++i) {
-    queues_.push_back(std::make_unique<EngineQueue>());
+    queues_.push_back(std::make_unique<EngineQueue>(
+        TenantQuantum(),
+        [this](uint16_t t) { return po_ ? po_->TenantWeightOf(t) : 1; }));
   }
   for (int i = 0; i < engine_threads; ++i) {
     threads_.emplace_back([this, i] { EngineLoop(i); });
@@ -120,17 +142,41 @@ void BytePSServer::Handle(Message&& msg, int fd) {
   } else if (msg.head.cmd == CMD_PULL) {
     BPS_METRIC_COUNTER_ADD("bps_server_pull_total", 1);
   }
+  // Per-tenant accounting (ISSUE 9): ops and push payload bytes by the
+  // frame's tenant stamp.
+  {
+    TenantStat* ts = Tenancy::Get().Of(msg.head.tenant);
+    ts->ops.fetch_add(1, std::memory_order_relaxed);
+    if (msg.head.cmd == CMD_PUSH) {
+      ts->push_bytes.fetch_add(static_cast<int64_t>(msg.payload.size()),
+                               std::memory_order_relaxed);
+    }
+  }
   // Per-op recv instant (ISSUE 5): the gap from here to the engine's
   // s_sum span is queueing delay inside this server — the signal that
   // separates "engine busy" from "summation slow" in the fleet view.
   Trace::Get().Instant("s_recv", msg.head.key, msg.head.sender,
                        msg.head.req_id, msg.head.cmd);
-  // Route by key so one key's operations are totally ordered on one thread.
-  size_t tid = static_cast<size_t>(msg.head.key) % queues_.size();
+  EnqueueTask(EngineTask{std::move(msg), fd, nullptr, -1});
+}
+
+void BytePSServer::EnqueueTask(EngineTask&& task) {
+  const uint16_t tenant = task.msg.head.tenant;
+  // Route by (tenant, key) so one tenant-key's operations are totally
+  // ordered on one thread. Tenant 0 composes to the bare key — the
+  // pre-tenant `key % threads` routing, bit for bit.
+  const size_t tid =
+      static_cast<size_t>(TenantKey(tenant, task.msg.head.key)) %
+      queues_.size();
+  const int64_t cost =
+      DrrCost(static_cast<int64_t>(task.msg.payload.size()));
+  TenantStat* ts = Tenancy::Get().Of(tenant);
+  ts->queue_depth.fetch_add(1, std::memory_order_relaxed);
   auto& eq = *queues_[tid];
   {
     std::lock_guard<std::mutex> lk(eq.mu);
-    eq.q.push_back(EngineTask{std::move(msg), fd, nullptr, -1});
+    eq.lanes[tenant].push_back(std::move(task));
+    eq.drr.Enqueue(tenant, cost);
   }
   eq.cv.notify_one();
 }
@@ -159,9 +205,13 @@ void BytePSServer::HandleMulti(Message&& msg, int fd) {
     for (int i = 0; i < count; ++i) pbytes += table[i].len;
     BPS_METRIC_COUNTER_ADD("bps_recv_bytes_total", pbytes);
     BPS_METRIC_COUNTER_ADD("bps_server_push_total", count);
+    Tenancy::Get().Of(h.tenant)->push_bytes.fetch_add(
+        pbytes, std::memory_order_relaxed);
   } else {
     BPS_METRIC_COUNTER_ADD("bps_server_pull_total", count);
   }
+  Tenancy::Get().Of(h.tenant)->ops.fetch_add(count,
+                                             std::memory_order_relaxed);
   BPS_METRIC_COUNTER_ADD("bps_fused_msgs_total", 1);
   BPS_METRIC_HISTO_OBSERVE("bps_fusion_batch_keys", count);
   Trace::Get().Instant("s_recv", h.key, h.sender, h.req_id, h.cmd);
@@ -169,6 +219,7 @@ void BytePSServer::HandleMulti(Message&& msg, int fd) {
   batch->fd = fd;
   batch->req_id = h.req_id;
   batch->reply_cmd = is_push ? CMD_MULTI_ACK : CMD_MULTI_PULL_RESP;
+  batch->tenant = h.tenant;
   batch->first_key = h.key;
   batch->subs.resize(count);
   batch->data.resize(count);
@@ -186,8 +237,13 @@ void BytePSServer::HandleMulti(Message&& msg, int fd) {
     BPS_CHECK((s.wire_dtype == BPS_INT8) ==
               ((s.flags & FLAG_WIRE_QUANT) != 0))
         << "sub-entry wire_dtype/quant-flag mismatch for key " << s.key;
+    // Sub-entry tenant must be the frame's (one frame = one sender =
+    // one tenant): a disagreeing table was corrupted or forged.
+    BPS_CHECK_EQ(s.tenant, h.tenant)
+        << "sub-entry tenant mismatch for key " << s.key;
     EngineTask t;
     t.msg.head.cmd = s.cmd;
+    t.msg.head.tenant = s.tenant;
     t.msg.head.sender = h.sender;
     t.msg.head.key = s.key;
     t.msg.head.req_id = h.req_id;
@@ -203,21 +259,23 @@ void BytePSServer::HandleMulti(Message&& msg, int fd) {
     t.fd = fd;
     t.batch = batch;
     t.sub_idx = i;
-    // Same key hash routing as single frames: all of a key's operations
-    // — fused or not — stay totally ordered on one engine thread, and
-    // the KeyStore keeps its single-writer invariant.
-    size_t tid = static_cast<size_t>(s.key) % queues_.size();
-    auto& eq = *queues_[tid];
-    {
-      std::lock_guard<std::mutex> lk(eq.mu);
-      eq.q.push_back(std::move(t));
-    }
-    eq.cv.notify_one();
+    // Same (tenant, key) hash routing as single frames: all of a key's
+    // operations — fused or not — stay totally ordered on one engine
+    // thread, and the KeyStore keeps its single-writer invariant.
+    EnqueueTask(std::move(t));
   }
 }
 
 void BytePSServer::SendReply(const EngineTask& t, MsgHeader& head,
                              const void* data, int64_t len) {
+  // Replies carry the request's tenant (one stamping point for every
+  // single-frame and fused sub-reply) and land in its reply-byte
+  // accounting. Tenant-0 requests stamp 0 — the pre-tenant bytes.
+  head.tenant = t.msg.head.tenant;
+  if (len > 0) {
+    Tenancy::Get().Of(head.tenant)->reply_bytes.fetch_add(
+        len, std::memory_order_relaxed);
+  }
   if (!t.batch) {
     po_->van().Send(t.fd, head, data, len);
     return;
@@ -230,7 +288,8 @@ void BytePSServer::SendReply(const EngineTask& t, MsgHeader& head,
                      ? static_cast<int16_t>(BPS_INT8)
                      : static_cast<int16_t>(0);
   s.version = head.version;
-  s.dtype = head.dtype;
+  s.dtype = static_cast<int16_t>(head.dtype);
+  s.tenant = head.tenant;
   s.flags = head.flags;
   s.arg0 = head.arg0;
   s.arg1 = head.arg1;
@@ -260,7 +319,8 @@ void BytePSServer::FlushMulti(const std::shared_ptr<MultiReply>& batch) {
     }
   }
   MsgHeader head{};
-  head.cmd = b.reply_cmd;
+  head.cmd = static_cast<int16_t>(b.reply_cmd);
+  head.tenant = b.tenant;
   head.sender = po_->my_id();
   head.key = b.first_key;
   head.req_id = b.req_id;
@@ -272,92 +332,172 @@ void BytePSServer::EngineLoop(int tid) {
   auto& eq = *queues_[tid];
   while (true) {
     EngineTask task;
+    uint16_t tenant;
+    int64_t cost = 0;
     {
       std::unique_lock<std::mutex> lk(eq.mu);
-      eq.cv.wait(lk, [&] { return stopped_.load() || !eq.q.empty(); });
-      if (stopped_.load() && eq.q.empty()) return;
-      task = std::move(eq.q.front());
-      eq.q.pop_front();
+      eq.cv.wait(lk, [&] { return stopped_.load() || !eq.drr.Empty(); });
+      if (stopped_.load() && eq.drr.Empty()) return;
+      // Weighted-DRR pick (ISSUE 9): which tenant's lane is served
+      // next. Single-tenant fleets short-circuit to FIFO inside the
+      // picker, so their dispatch order is byte-for-byte PR 8's.
+      tenant = eq.drr.PickAndPop(&cost);
+      auto& lane = eq.lanes[tenant];
+      task = std::move(lane.front());
+      lane.pop_front();
     }
+    TenantStat* ts = Tenancy::Get().Of(tenant);
+    ts->queue_depth.fetch_sub(1, std::memory_order_relaxed);
+    ts->dispatched.fetch_add(cost, std::memory_order_relaxed);
+    ts->last_serve_us.store(NowUs(), std::memory_order_relaxed);
     if (task.msg.head.cmd == kCmdShrink) {
-      ShrinkWorker(tid, static_cast<int>(task.msg.head.arg0));
+      ShrinkWorker(tid, static_cast<int>(task.msg.head.arg0), tenant);
       continue;
     }
     Process(std::move(task));
+    if (engine_pace_bps_ > 0 && cost > 0) {
+      // Service-rate cap: sleep off the dispatched cost so the engine
+      // serves at most pace bytes/s — under offered load the lanes
+      // stay backlogged and the DRR share is exactly the weight ratio.
+      int64_t us = cost * 1000000 / engine_pace_bps_;
+      while (us > 0 && !stopped_.load(std::memory_order_relaxed)) {
+        const int64_t chunk = us > 20000 ? 20000 : us;
+        usleep(static_cast<useconds_t>(chunk));
+        us -= chunk;
+      }
+    }
   }
 }
 
+RosterHistory* BytePSServer::RosterOf(uint16_t tenant) {
+  std::lock_guard<std::mutex> lk(roster_mu_);
+  auto& r = rosters_[tenant];
+  if (!r) {
+    // Lazy per-tenant arming (ISSUE 9): the first reference seeds the
+    // history from the address book's current tenant roster. Tenant 0
+    // was pre-seeded from the formation env at Start (PR 8, byte for
+    // byte); this path only runs for tenants the env cannot know.
+    r = std::make_unique<RosterHistory>();
+    r->Init(po_ ? po_->TenantWorkers(tenant) : std::set<int>());
+  }
+  return r.get();
+}
+
 void BytePSServer::OnFleetResize(int kind, int affected,
-                                 int64_t join_round, int64_t join_bcast) {
+                                 int64_t join_round, int64_t join_bcast,
+                                 int tenant) {
   if (!elastic_) return;
+  const uint16_t t16 = static_cast<uint16_t>(tenant);
   if (kind == 0) {
-    // Join: a fresh roster epoch activates at the gated round boundary.
-    // Rounds already in flight keep completing against the old set —
-    // no store surgery needed. The re-eval tasks below (affected = -1:
-    // nothing to discard) close a race: a member's first new-roster
-    // push can arrive on a data connection BEFORE this control-plane
-    // RESUME was processed, in which case its completion check ran
-    // against the stale roster and nothing later would re-trigger it.
-    roster_.Join(affected, join_round, join_bcast);
+    // Join: a fresh roster epoch for the JOINER'S TENANT activates at
+    // that tenant's gated round boundary (rounds are per-tenant
+    // counters — another tenant's history must not move). Rounds
+    // already in flight keep completing against the old set — no store
+    // surgery needed. A first-ever reference here must seed the
+    // pre-join roster: the address book already contains the joiner,
+    // so it is excluded from the epoch-0 set and enters only at its
+    // activation epoch.
+    {
+      std::lock_guard<std::mutex> lk(roster_mu_);
+      auto& r = rosters_[t16];
+      if (!r) {
+        std::set<int> pre = po_->TenantWorkers(t16);
+        pre.erase(affected);
+        r = std::make_unique<RosterHistory>();
+        r->Init(pre);
+      }
+      r->Join(affected, join_round, join_bcast);
+    }
     BPS_LOG(WARNING) << "server: roster epoch — worker " << affected
-                     << " joins at round " << join_round;
+                     << " (tenant " << tenant << ") joins at round "
+                     << join_round;
     for (auto& eq : queues_) {
       EngineTask t;
       t.msg.head.cmd = kCmdShrink;
+      t.msg.head.tenant = t16;
       t.msg.head.arg0 = -1;
-      {
-        std::lock_guard<std::mutex> lk(eq->mu);
-        eq->q.push_back(std::move(t));
-      }
-      eq->cv.notify_one();
+      EnqueueTaskTo(*eq, std::move(t));
     }
     return;
   }
-  // Removal: erase the id from EVERY roster (a leaver drained before
-  // leaving, and a dead rank's partial contributions are discarded by
-  // the rollback below — so no incomplete round legitimately expects
-  // it), then re-evaluate each engine thread's keys: blocked rounds
-  // whose only missing contributor was the departed rank become ready.
-  roster_.Remove(affected);
+  // Removal: erase the id from EVERY epoch of its tenant's roster (a
+  // leaver drained before leaving, and a dead rank's partial
+  // contributions are discarded by the rollback below — so no
+  // incomplete round legitimately expects it), then re-evaluate each
+  // engine thread's keys for that tenant: blocked rounds whose only
+  // missing contributor was the departed rank become ready.
+  RosterOf(t16)->Remove(affected);
   BPS_LOG(WARNING) << "server: roster epoch — worker " << affected
+                   << " (tenant " << tenant << ")"
                    << (kind == 1 ? " left" : " died")
                    << "; rolling in-flight rounds onto the survivors";
   for (auto& eq : queues_) {
     EngineTask t;
     t.msg.head.cmd = kCmdShrink;
+    t.msg.head.tenant = t16;
     t.msg.head.arg0 = affected;
-    {
-      std::lock_guard<std::mutex> lk(eq->mu);
-      eq->q.push_back(std::move(t));
-    }
-    eq->cv.notify_one();
+    EnqueueTaskTo(*eq, std::move(t));
   }
 }
 
-int BytePSServer::ExpectedContributors(int64_t version) {
-  if (!elastic_) return po_->num_workers();
-  return static_cast<int>(roster_.OfRound(version)->size());
+void BytePSServer::EnqueueTaskTo(EngineQueue& eq, EngineTask&& task) {
+  // Internal control marker: rides the affected tenant's lane so it
+  // stays FIFO-ordered behind that tenant's already-received data ops
+  // (the PR 8 per-thread ordering, now per tenant). Zero DRR cost —
+  // a rollback must not charge anyone's fair share.
+  const uint16_t tenant = task.msg.head.tenant;
+  Tenancy::Get().Of(tenant)->queue_depth.fetch_add(
+      1, std::memory_order_relaxed);
+  {
+    std::lock_guard<std::mutex> lk(eq.mu);
+    eq.lanes[tenant].push_back(std::move(task));
+    eq.drr.Enqueue(tenant, 0);
+  }
+  eq.cv.notify_one();
+}
+
+int BytePSServer::TenantWorkerCount(uint16_t tenant) {
+  const int n = po_ ? po_->TenantWorkerCount(tenant) : 0;
+  // Legacy fallback: before the address book arrives (or in a fleet
+  // with no tenant registrations at all) tenant 0 is everyone — the
+  // pre-tenant fleet-size check, byte for byte.
+  if (n == 0 && tenant == 0) return po_ ? po_->num_workers() : 0;
+  return n;
+}
+
+int BytePSServer::ExpectedContributors(const KeyStore* ks,
+                                       int64_t version) {
+  if (!elastic_) return TenantWorkerCount(ks->tenant);
+  return static_cast<int>(RosterOf(ks->tenant)->OfRound(version)->size());
 }
 
 bool BytePSServer::RoundComplete(KeyStore* ks, int slot, int64_t version) {
-  if (!elastic_) return ks->push_count[slot] == po_->num_workers();
-  auto roster = roster_.OfRound(version);
+  if (!elastic_) {
+    return ks->push_count[slot] == TenantWorkerCount(ks->tenant);
+  }
+  auto roster = RosterOf(ks->tenant)->OfRound(version);
   return !roster->empty() && ks->er[slot].PushersMatch(*roster);
 }
 
 bool BytePSServer::RoundServed(KeyStore* ks, int slot, int64_t version) {
-  if (!elastic_) return ks->pull_count[slot] == po_->num_workers();
-  auto roster = roster_.OfRound(version);
+  if (!elastic_) {
+    return ks->pull_count[slot] == TenantWorkerCount(ks->tenant);
+  }
+  auto roster = RosterOf(ks->tenant)->OfRound(version);
   return !roster->empty() && ks->er[slot].PullersCover(*roster);
 }
 
-void BytePSServer::ShrinkWorker(int tid, int dead) {
+void BytePSServer::ShrinkWorker(int tid, int dead, uint16_t tenant) {
   std::vector<KeyStore*> mine;
   {
     std::lock_guard<std::mutex> lk(store_mu_);
     for (auto& kv : store_) {
+      // This thread's keys, restricted to the affected TENANT: the
+      // departed worker never contributed to another tenant's slots,
+      // and their completion rosters did not move.
       if (static_cast<size_t>(kv.first) % queues_.size() ==
-          static_cast<size_t>(tid)) {
+              static_cast<size_t>(tid) &&
+          kv.second->tenant == tenant) {
         mine.push_back(kv.second.get());
       }
     }
@@ -435,9 +575,10 @@ void BytePSServer::ShrinkWorker(int tid, int dead) {
   if (dead >= 0) Trace::Get().Note("WORKER_SHRINK", rolled, dead, -1, completed);
 }
 
-BytePSServer::KeyStore* BytePSServer::GetStore(int64_t key) {
+BytePSServer::KeyStore* BytePSServer::GetStore(uint16_t tenant,
+                                               int64_t key) {
   std::lock_guard<std::mutex> lk(store_mu_);
-  auto it = store_.find(key);
+  auto it = store_.find(TenantKey(tenant, key));
   return it == store_.end() ? nullptr : it->second.get();
 }
 
@@ -455,6 +596,7 @@ void BytePSServer::MarkReplied(KeyStore* ks, int32_t sender,
 void BytePSServer::SendKeepalive(const EngineTask& t) {
   MsgHeader ka{};
   ka.cmd = CMD_KEEPALIVE;
+  ka.tenant = t.msg.head.tenant;
   ka.sender = po_->my_id();
   ka.key = t.msg.head.key;
   ka.req_id = t.msg.head.req_id;
@@ -473,6 +615,7 @@ void BytePSServer::SendWireError(int fd, const MsgHeader& req,
                                  const std::string& why) {
   MsgHeader err{};
   err.cmd = CMD_ERROR;
+  err.tenant = req.tenant;
   err.sender = po_->my_id();
   err.key = req.key;
   err.req_id = req.req_id;
@@ -579,7 +722,7 @@ void BytePSServer::Process(EngineTask&& task) {
   if (recover_mode_.load(std::memory_order_relaxed) &&
       (h.cmd == CMD_PUSH || h.cmd == CMD_PULL || h.cmd == CMD_BCAST_PUSH ||
        h.cmd == CMD_BCAST_PULL || h.cmd == CMD_RESEED) &&
-      GetStore(h.key) == nullptr) {
+      GetStore(h.tenant, h.key) == nullptr) {
     if (NowUs() < recover_grace_end_us_) {
       if (ParkUndeclared(std::move(task))) return;
     } else {
@@ -591,7 +734,7 @@ void BytePSServer::Process(EngineTask&& task) {
   if (RetryEnabled() && !task.from_park &&
       (h.cmd == CMD_PUSH || h.cmd == CMD_PULL || h.cmd == CMD_BCAST_PUSH ||
        h.cmd == CMD_BCAST_PULL || h.cmd == CMD_RESEED)) {
-    KeyStore* ks = GetStore(h.key);
+    KeyStore* ks = GetStore(h.tenant, h.key);
     if (ks) {
       auto& rec = ks->seen[h.sender];
       if (rec.req_id == h.req_id) {
@@ -610,9 +753,10 @@ void BytePSServer::Process(EngineTask&& task) {
     case CMD_INIT_KEY: {
       {
         std::lock_guard<std::mutex> lk(store_mu_);
-        auto& ks = store_[h.key];
+        auto& ks = store_[TenantKey(h.tenant, h.key)];
         if (!ks) {
           ks = std::make_unique<KeyStore>();
+          ks->tenant = h.tenant;
           ks->len = h.arg0;
           ks->dtype = h.dtype;
           ks->comp_config.assign(msg.payload.begin(), msg.payload.end());
@@ -660,7 +804,7 @@ void BytePSServer::Process(EngineTask&& task) {
       std::vector<EngineTask> parked;
       {
         std::lock_guard<std::mutex> lk(store_mu_);
-        auto it = pre_declare_parked_.find(h.key);
+        auto it = pre_declare_parked_.find(TenantKey(h.tenant, h.key));
         if (it != pre_declare_parked_.end()) {
           parked = std::move(it->second);
           pre_declare_parked_.erase(it);
@@ -671,7 +815,7 @@ void BytePSServer::Process(EngineTask&& task) {
     }
 
     case CMD_PUSH: {
-      KeyStore* ks = GetStore(h.key);
+      KeyStore* ks = GetStore(h.tenant, h.key);
       BPS_CHECK(ks) << "push for undeclared key " << h.key;
       const bool is_async = async_ || (h.flags & FLAG_ASYNC);
       if (!is_async) {
@@ -826,6 +970,11 @@ void BytePSServer::Process(EngineTask&& task) {
         RoundStats::Get().Track(
             RS_SUM, h.version, sum_us,
             static_cast<int64_t>(msg.payload.size()));
+        // Per-tenant engine time (ISSUE 9): rides the same clock, so
+        // the off switch (BYTEPS_ROUNDSTATS_ON=0) keeps the hot path
+        // one relaxed load, exactly as before.
+        Tenancy::Get().Of(h.tenant)->sum_us.fetch_add(
+            sum_us, std::memory_order_relaxed);
       }
       MsgHeader ack{};
       ack.cmd = CMD_PUSH_ACK;
@@ -849,7 +998,7 @@ void BytePSServer::Process(EngineTask&& task) {
     }
 
     case CMD_PULL: {
-      KeyStore* ks = GetStore(h.key);
+      KeyStore* ks = GetStore(h.tenant, h.key);
       BPS_CHECK(ks) << "pull for undeclared key " << h.key;
       if (async_ || (h.flags & FLAG_ASYNC)) {
         MsgHeader resp{};
@@ -894,7 +1043,7 @@ void BytePSServer::Process(EngineTask&& task) {
       // offers for one round carry identical bytes (they are the same
       // completed sum), so replays and multi-worker offers are
       // idempotent.
-      KeyStore* ks = GetStore(h.key);
+      KeyStore* ks = GetStore(h.tenant, h.key);
       BPS_CHECK(ks) << "reseed for undeclared key " << h.key;
       Trace::Get().Note("RESEED", h.key, h.sender, h.req_id, h.version);
       int slot = h.version & 1;
@@ -916,7 +1065,7 @@ void BytePSServer::Process(EngineTask&& task) {
         ks->last_round[slot] = h.version;
         // The reseed IS a completed round's sum over the then-full
         // fleet: its mean divisor is the current worker count.
-        ks->last_contrib_n[slot] = po_->num_workers();
+        ks->last_contrib_n[slot] = TenantWorkerCount(ks->tenant);
         // The slot may already be accumulating this round from
         // recovery re-pushes that arrived first; the reseed IS that
         // round's final sum — supersede the partial accumulation.
@@ -955,20 +1104,23 @@ void BytePSServer::Process(EngineTask&& task) {
     }
 
     case CMD_BCAST_PUSH: {
-      KeyStore* ks = GetStore(h.key);
+      KeyStore* ks = GetStore(h.tenant, h.key);
       BPS_CHECK(ks) << "bcast_push for undeclared key " << h.key;
       int round = h.version;
       // async pulls read ks->param; keep it tracking the latest round.
       ks->param.assign(msg.payload.begin(), msg.payload.end());
       ks->param_init = true;
       ks->last_bcast_round = round;  // bcast-pull replay fallback
-      // Non-root pulls this round expects: the round's roster size
-      // minus the root. Broadcasts count rounds in their own space, so
-      // a join's bcast activation point picks the roster (ISSUE 8).
+      // Non-root pulls this round expects: the round's TENANT roster
+      // size minus the root (ISSUE 9: a broadcast is a within-job
+      // collective — only the pushing job's workers pull it).
+      // Broadcasts count rounds in their own space, so a join's bcast
+      // activation point picks the roster (ISSUE 8).
       int waiters =
           (elastic_
-               ? static_cast<int>(roster_.OfBcast(round)->size())
-               : po_->num_workers()) -
+               ? static_cast<int>(
+                     RosterOf(ks->tenant)->OfBcast(round)->size())
+               : TenantWorkerCount(ks->tenant)) -
           1;
       if (waiters > 0) {
         auto& br = ks->bcast_rounds[round];
@@ -992,6 +1144,7 @@ void BytePSServer::Process(EngineTask&& task) {
       }
       MsgHeader ack{};
       ack.cmd = CMD_PUSH_ACK;
+      ack.tenant = h.tenant;
       ack.sender = po_->my_id();
       ack.key = h.key;
       ack.req_id = h.req_id;
@@ -1010,7 +1163,7 @@ void BytePSServer::Process(EngineTask&& task) {
     }
 
     case CMD_BCAST_PULL: {
-      KeyStore* ks = GetStore(h.key);
+      KeyStore* ks = GetStore(h.tenant, h.key);
       BPS_CHECK(ks) << "bcast_pull for undeclared key " << h.key;
       if (ks->bcast_rounds.count(h.version)) {
         ServeBcastRound(ks, h.version, fd, h);
@@ -1065,7 +1218,9 @@ bool BytePSServer::ParkUndeclared(EngineTask&& task) {
                    << " for not-yet-redeclared key " << task.msg.head.key
                    << " (re-seed in progress)";
   std::lock_guard<std::mutex> lk(store_mu_);
-  pre_declare_parked_[task.msg.head.key].push_back(std::move(task));
+  pre_declare_parked_[TenantKey(task.msg.head.tenant,
+                               task.msg.head.key)]
+      .push_back(std::move(task));
   return true;
 }
 
@@ -1263,6 +1418,7 @@ void BytePSServer::ReplayParked(KeyStore* ks, int slot) {
 void BytePSServer::ReplyBcastPull(KeyStore* ks, int fd, const MsgHeader& req) {
   MsgHeader resp{};
   resp.cmd = CMD_PULL_RESP;
+  resp.tenant = req.tenant;
   resp.sender = po_->my_id();
   resp.key = req.key;
   resp.req_id = req.req_id;
@@ -1276,6 +1432,7 @@ void BytePSServer::ServeBcastRound(KeyStore* ks, int round, int fd,
   BPS_CHECK(it != ks->bcast_rounds.end());
   MsgHeader resp{};
   resp.cmd = CMD_PULL_RESP;
+  resp.tenant = req.tenant;
   resp.sender = po_->my_id();
   resp.key = req.key;
   resp.req_id = req.req_id;
@@ -1288,12 +1445,14 @@ void BytePSServer::ServeBcastRound(KeyStore* ks, int round, int fd,
   // frozen a stale (smaller) roster; taking the max against the
   // round's CURRENT roster keeps the round alive for the joiner's
   // pull instead of erasing it one pull early.
-  int waiters =
-      it->second.waiters > 0 ? it->second.waiters : po_->num_workers() - 1;
+  int waiters = it->second.waiters > 0
+                    ? it->second.waiters
+                    : TenantWorkerCount(ks->tenant) - 1;
   if (elastic_) {
     waiters = std::max(
         waiters,
-        static_cast<int>(roster_.OfBcast(round)->size()) - 1);
+        static_cast<int>(RosterOf(ks->tenant)->OfBcast(round)->size()) -
+            1);
   }
   if (++it->second.served >= waiters) {
     ks->bcast_rounds.erase(it);

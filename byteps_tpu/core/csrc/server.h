@@ -31,6 +31,7 @@
 #include <atomic>
 #include <condition_variable>
 #include <deque>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <thread>
@@ -41,6 +42,7 @@
 #include "compressor.h"
 #include "elastic.h"
 #include "postoffice.h"
+#include "tenancy.h"
 
 namespace bps {
 
@@ -60,8 +62,11 @@ class BytePSServer {
   // thread: the dead rank's partial contributions are discarded, the
   // survivors' retained bytes re-summed, and every slot's readiness /
   // recycle re-evaluated against the shrunk roster.
+  // `tenant` scopes the change (ISSUE 9): rounds are per-tenant
+  // counters, so the roster epoch lands in that tenant's history only
+  // and the re-eval/rollback tasks visit only that tenant's keys.
   void OnFleetResize(int kind, int affected, int64_t join_round,
-                     int64_t join_bcast);
+                     int64_t join_bcast, int tenant);
 
  private:
   // Accumulator for one fused frame's batched reply. subs/data are
@@ -73,6 +78,7 @@ class BytePSServer {
     int fd = -1;
     int32_t req_id = -1;
     int32_t reply_cmd = 0;  // CMD_MULTI_ACK or CMD_MULTI_PULL_RESP
+    uint16_t tenant = 0;    // the frame's tenant (one frame, one tenant)
     int64_t first_key = 0;
     std::atomic<int> remaining{0};
     std::vector<SubHeader> subs;
@@ -80,6 +86,7 @@ class BytePSServer {
   };
 
   struct KeyStore;
+  struct EngineQueue;
 
   // One unit of engine work: a single frame, or one sub-operation of a
   // fused frame (batch != nullptr; sub_idx = its reply slot).
@@ -99,6 +106,11 @@ class BytePSServer {
   };
 
   struct KeyStore {
+    // Owning tenant (ISSUE 9): set at INIT_KEY from the declaring
+    // frame. The store map keys on TenantKey(tenant, key), so two
+    // tenants' colliding tids can never alias; this field is the
+    // back-reference for completion counts, rosters, and accounting.
+    uint16_t tenant = 0;
     // Idempotent-retry dedup window (ISSUE 3): per sender, the last
     // data-plane request seen for this key. Per key per sender at most
     // ONE request chain is outstanding (the worker's per-key ordering
@@ -230,7 +242,17 @@ class BytePSServer {
   void SendReply(const EngineTask& t, MsgHeader& head,
                  const void* data = nullptr, int64_t len = 0);
   void FlushMulti(const std::shared_ptr<MultiReply>& batch);
-  KeyStore* GetStore(int64_t key);
+  // Store lookup is (tenant, key)-namespaced (ISSUE 9); tenant 0
+  // composes to the bare key, so a legacy fleet's store map — and its
+  // `key % threads` engine routing — is bit-for-bit the pre-tenant one.
+  KeyStore* GetStore(uint16_t tenant, int64_t key);
+  // Route an engine task to its key's thread through the per-tenant
+  // DRR lanes (the one enqueue point: depth/cost accounting lives
+  // here).
+  void EnqueueTask(EngineTask&& task);
+  // Zero-cost control marker into a specific queue's tenant lane
+  // (roster re-eval / rollback tasks).
+  void EnqueueTaskTo(EngineQueue& eq, EngineTask&& task);
   // Returns true when this pull completed the round and recycled the
   // slot (caller must then ReplayParked).
   bool ReplyPull(KeyStore* ks, int slot, const EngineTask& t);
@@ -264,8 +286,13 @@ class BytePSServer {
   // recycled the slot. Shared by the push path and the shrink rollback.
   void RoundReady(KeyStore* ks, int slot);
   // Expected contributor count for round `version` of a sync key: the
-  // roster size when elastic, the fixed fleet size otherwise.
-  int ExpectedContributors(int64_t version);
+  // key's TENANT roster size when elastic, the tenant's live worker
+  // count otherwise (tenant 0 falls back to the fleet size until the
+  // address book arrives — the pre-tenant behavior).
+  int ExpectedContributors(const KeyStore* ks, int64_t version);
+  // The tenant's worker count from the address book, with the legacy
+  // tenant-0 fallback above.
+  int TenantWorkerCount(uint16_t tenant);
   // True when round `version`'s contributor set is complete. The
   // elastic check is EXACT set equality against the round's roster —
   // see ElasticSlot::PushersMatch for why superset would be unsound
@@ -273,19 +300,34 @@ class BytePSServer {
   bool RoundComplete(KeyStore* ks, int slot, int64_t version);
   // True when every roster member pulled round `version` (recycle).
   bool RoundServed(KeyStore* ks, int slot, int64_t version);
-  // Death-shrink rollback for this engine thread's keys (tid-owned):
-  // discard `dead`'s partial contributions, rebuild sums from the
-  // survivors' retained bytes, drop its parked/pending ops, and
-  // re-evaluate every slot against the shrunk roster.
-  void ShrinkWorker(int tid, int dead);
+  // Death-shrink rollback for this engine thread's keys (tid-owned),
+  // scoped to the departed worker's TENANT (other tenants' slots never
+  // held its contributions): discard `dead`'s partial contributions,
+  // rebuild sums from the survivors' retained bytes, drop its
+  // parked/pending ops, and re-evaluate every slot against the shrunk
+  // roster.
+  void ShrinkWorker(int tid, int dead, uint16_t tenant);
 
-  // Elastic state: armed flag + the fleet's per-epoch contributor
-  // roster history (activation-round keyed; see elastic.h).
+  // Elastic state: armed flag + per-TENANT epoch roster histories
+  // (activation-round keyed in that tenant's round space; see
+  // elastic.h). Tenant 0 is pre-seeded from the formation env at
+  // Start (the PR 8 behavior, byte for byte); other tenants
+  // initialise lazily from the address book.
   bool elastic_ = false;
-  RosterHistory roster_;
+  RosterHistory* RosterOf(uint16_t tenant);
+  std::mutex roster_mu_;  // guards the map shape, not the histories
+  std::map<uint16_t, std::unique_ptr<RosterHistory>> rosters_;
 
   Postoffice* po_ = nullptr;
   bool async_ = false;
+  // Engine service-rate cap per engine thread (ISSUE 9;
+  // BYTEPS_SERVER_ENGINE_PACE_MBPS, 0 = off): after each dispatched
+  // data task the engine sleeps cost/rate. Ops knob for capping a
+  // shared server's CPU burn — and the calibration lever the
+  // weighted-split QoS tests/bench use to create honest engine
+  // contention on a loopback fleet (an unloaded engine never
+  // backlogs, and fair-share is only observable under backlog).
+  int64_t engine_pace_bps_ = 0;
   // Quantized wire knobs (ISSUE 6), read from the same env the worker
   // reads so both sides compute identical eligibility.
   bool wire_quant_ = false;          // BYTEPS_WIRE_QUANT
@@ -304,10 +346,20 @@ class BytePSServer {
   std::unordered_map<int64_t, std::unique_ptr<KeyStore>> store_;
   std::unordered_map<int64_t, std::vector<EngineTask>> pre_declare_parked_;
 
+  // Per-tenant FIFO lanes dispatched by weighted deficit round robin
+  // (ISSUE 9, tenancy.h): whenever two tenants' lanes are both
+  // backlogged, the engine serves their bytes in the ratio of their
+  // BYTEPS_TENANT_WEIGHT shares — a heavy tenant cannot starve a light
+  // one. `drr` mirrors the lanes cost-for-cost (enqueue/pop pairs run
+  // under `mu`); with a single active tenant the picker short-circuits
+  // to plain FIFO, keeping single-tenant dispatch byte-for-byte PR 8's.
   struct EngineQueue {
+    EngineQueue(int64_t quantum, WeightedDrr::WeightFn wf)
+        : drr(quantum, std::move(wf)) {}
     std::mutex mu;
     std::condition_variable cv;
-    std::deque<EngineTask> q;
+    std::map<uint16_t, std::deque<EngineTask>> lanes;
+    WeightedDrr drr;
   };
   std::vector<std::unique_ptr<EngineQueue>> queues_;
   std::vector<std::thread> threads_;

@@ -11,6 +11,7 @@
 #include "logging.h"
 #include "metrics.h"
 #include "roundstats.h"
+#include "tenancy.h"
 
 namespace bps {
 
@@ -957,6 +958,7 @@ void BytePSWorker::SendFusedPush(int server_id, std::vector<PushOp> ops) {
     SubHeader& s = table[i];
     s.key = op.p->key;
     s.cmd = CMD_PUSH;
+    s.tenant = TenantId();  // one frame = one tenant (ISSUE 9)
     // Wire-dtype of the sub-payload: BPS_INT8 marks the block-quantized
     // encoding (FLAG_WIRE_QUANT rides in flags too — the engine-side
     // dequant keys on the flag, the table field is the wire contract
@@ -966,7 +968,7 @@ void BytePSWorker::SendFusedPush(int server_id, std::vector<PushOp> ops) {
                        ? static_cast<int16_t>(BPS_INT8)
                        : static_cast<int16_t>(0);
     s.version = op.version;
-    s.dtype = op.ctx->dtype;
+    s.dtype = static_cast<int16_t>(op.ctx->dtype);
     s.flags = op.flags;
     s.arg0 = op.raw_len;
     s.offset = off;
@@ -1073,8 +1075,9 @@ void BytePSWorker::OnFusedAck(
     SubHeader& s = table[i];
     s.key = op.p->key;
     s.cmd = CMD_PULL;
+    s.tenant = TenantId();
     s.version = op.version;
-    s.dtype = op.ctx->dtype;
+    s.dtype = static_cast<int16_t>(op.ctx->dtype);
     // FLAG_WIRE_QUANT requests the re-quantized aggregate for keys this
     // worker pushed quantized (see the single-frame pull's comment);
     // wire_dtype mirrors it (the REQUESTED reply encoding — a pull has

@@ -124,6 +124,20 @@ def _load() -> ctypes.CDLL:
     lib.bps_elastic_probe.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                       ctypes.c_longlong]
     lib.bps_elastic_probe.restype = ctypes.c_longlong
+    # Multi-tenant PS (ISSUE 9): tenant identity, the per-tenant
+    # accounting/roster snapshot, the no-topology DRR/namespacing
+    # probe, and the wire-layout pin for the A/B byte-identity test.
+    lib.bps_tenant_id.argtypes = []
+    lib.bps_tenant_id.restype = ctypes.c_int
+    lib.bps_tenant_summary.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+    lib.bps_tenant_summary.restype = ctypes.c_longlong
+    lib.bps_tenant_probe.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                     ctypes.c_longlong]
+    lib.bps_tenant_probe.restype = ctypes.c_longlong
+    lib.bps_wire_header_probe.argtypes = [ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_longlong, ctypes.c_int,
+                                          ctypes.c_void_p]
+    lib.bps_wire_header_probe.restype = ctypes.c_int
     _lib = lib
     return lib
 
@@ -201,6 +215,61 @@ def elastic_probe(script: str) -> dict:
         if need < size:
             return json.loads(buf.value.decode())
         size = need + 1
+
+
+def tenant_id() -> int:
+    """This process's tenant id (BYTEPS_TENANT_ID; 0 = legacy)."""
+    return int(_load().bps_tenant_id())
+
+
+def tenant_summary() -> dict:
+    """Multi-tenant snapshot (ISSUE 9): this process's tenant identity,
+    the per-tenant accounting registry (servers: bytes / ops / engine
+    queue depth / sum time / DRR dispatch + starvation age), and the
+    address-book tenant roster. Served raw at the monitor endpoint's
+    /tenants path."""
+    import json
+
+    lib = _load()
+    size = 1 << 16
+    while True:
+        buf = ctypes.create_string_buffer(size)
+        need = int(lib.bps_tenant_summary(buf, size))
+        if need < size:
+            return json.loads(buf.value.decode())
+        size = need + 1
+
+
+def tenant_probe(script: str) -> dict:
+    """Drive the C core's standalone weighted-DRR dispatch + (tenant,
+    key) namespacing arithmetic (ISSUE 9) through a `;`-separated op
+    script (quantum:/weight:/enq:/pop:/key:/route:) and return the
+    dispatch order, per-tenant served cost, composed keys and engine
+    routes — the no-fleet unit-test surface, modeled on elastic_probe.
+    Raises ValueError on a malformed script."""
+    import json
+
+    lib = _load()
+    size = 1 << 16
+    while True:
+        buf = ctypes.create_string_buffer(size)
+        need = int(lib.bps_tenant_probe(script.encode(), buf, size))
+        if need < 0:
+            raise ValueError(f"malformed tenant probe script {script!r}")
+        if need < size:
+            return json.loads(buf.value.decode())
+        size = need + 1
+
+
+def wire_header_probe(cmd: int, tenant: int, key: int,
+                      version: int) -> bytes:
+    """Serialize a MsgHeader with the given fields exactly as the C
+    core puts it on the wire (the ISSUE 9 A/B byte-identity pin: a
+    tenant-0 header must equal the pre-tenant layout bit for bit)."""
+    lib = _load()
+    buf = ctypes.create_string_buffer(64)
+    n = int(lib.bps_wire_header_probe(cmd, tenant, key, version, buf))
+    return buf.raw[:n]
 
 
 def leave_requested() -> bool:
@@ -333,6 +402,21 @@ def _apply_config_env(cfg: Optional[Config]) -> None:
     # projected.
     os.environ["BYTEPS_ELASTIC"] = "1" if cfg.elastic else "0"
     os.environ["BYTEPS_ELASTIC_TIMEOUT_MS"] = str(cfg.elastic_timeout_ms)
+    # Multi-tenant PS (ISSUE 9): projected only when the job opted in —
+    # leaving BYTEPS_TENANT_ID unset is the contract that keeps the
+    # wire format and engine dispatch byte-for-byte the single-tenant
+    # ones, and writing "0" here would still enrol the weight stamp.
+    if cfg.tenant_id is not None:
+        os.environ["BYTEPS_TENANT_ID"] = str(cfg.tenant_id)
+        if cfg.tenant_name:
+            os.environ["BYTEPS_TENANT_NAME"] = cfg.tenant_name
+        os.environ["BYTEPS_TENANT_WEIGHT"] = str(cfg.tenant_weight)
+        os.environ["BYTEPS_TENANT_QUANTUM_BYTES"] = str(
+            cfg.tenant_quantum_bytes)
+        os.environ["BYTEPS_TENANT_STARVE_MS"] = str(cfg.tenant_starve_ms)
+    if cfg.server_engine_pace_mbps > 0:
+        os.environ["BYTEPS_SERVER_ENGINE_PACE_MBPS"] = str(
+            cfg.server_engine_pace_mbps)
     os.environ["BYTEPS_CHAOS_SEED"] = str(cfg.chaos_seed)
     os.environ["BYTEPS_CHAOS_DROP"] = str(cfg.chaos_drop)
     os.environ["BYTEPS_CHAOS_DUP"] = str(cfg.chaos_dup)

@@ -436,6 +436,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "at runtime, and a dead worker is retired via "
                         "the shrink path (with --supervise N, a fresh "
                         "joiner replaces the capacity)")
+    p.add_argument("--tenant", type=int, metavar="ID", default=None,
+                   help="register this job under tenant ID "
+                        "(BYTEPS_TENANT_ID, docs/multitenancy.md): its "
+                        "keys are (tenant, key)-namespaced server-side "
+                        "and its traffic rides the weighted-fair engine "
+                        "dispatch; unset keeps the single-tenant wire "
+                        "byte for byte")
+    p.add_argument("--tenant-weight", type=int, metavar="W", default=1,
+                   help="this tenant's fair-share weight "
+                        "(BYTEPS_TENANT_WEIGHT): backlogged tenants' "
+                        "served bytes converge to the weight ratio")
+    p.add_argument("--tenant-name", metavar="NAME", default="",
+                   help="display name for /tenants and monitor.top "
+                        "(BYTEPS_TENANT_NAME; never on the wire)")
     p.add_argument("--scale-file", metavar="PATH", default="",
                    help="--local --elastic mode: file holding the "
                         "target worker count, read on SIGHUP (default: "
@@ -492,6 +506,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         os.environ["BYTEPS_ROUNDSTATS_ON"] = "0"
     if args.elastic:
         os.environ["BYTEPS_ELASTIC"] = "1"
+    if args.tenant is not None:
+        # Multi-tenant PS (ISSUE 9): one launcher invocation = one job
+        # = one tenant; every role it spawns carries the id, and
+        # workers register the weight with the scheduler. Leaving
+        # --tenant off keeps the single-tenant wire byte for byte.
+        os.environ["BYTEPS_TENANT_ID"] = str(args.tenant)
+        os.environ["BYTEPS_TENANT_WEIGHT"] = str(args.tenant_weight)
+        if args.tenant_name:
+            os.environ["BYTEPS_TENANT_NAME"] = args.tenant_name
     if args.chaos:
         chaos_envs = {"drop": "BYTEPS_CHAOS_DROP",
                       "dup": "BYTEPS_CHAOS_DUP",

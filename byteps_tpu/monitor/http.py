@@ -50,6 +50,26 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 body = json.dumps(doc).encode()
                 ctype = "application/json"
                 code = 200
+            elif self.path.split("?")[0] == "/tenants":
+                # Multi-tenant snapshot (ISSUE 9): this role's tenant
+                # identity, per-tenant accounting (servers: bytes /
+                # ops / engine queue depth / DRR dispatch), and the
+                # address-book roster. `starved` applies the
+                # BYTEPS_TENANT_STARVE_MS threshold (default 2000) to
+                # the raw starvation age the C side reports.
+                import os as _os
+
+                from byteps_tpu.core.ffi import tenant_summary
+                doc = tenant_summary()
+                starve_ms = float(
+                    _os.environ.get("BYTEPS_TENANT_STARVE_MS", "2000")
+                    or 2000)
+                for st in (doc.get("stats", {}) or {}).values():
+                    st["starved"] = (
+                        st.get("starve_us", 0) / 1000.0 > starve_ms)
+                body = json.dumps(doc).encode()
+                ctype = "application/json"
+                code = 200
             elif self.path.split("?")[0] == "/healthz":
                 snap = _metrics.snapshot()
                 dead = snap.get("dead_nodes", [])

@@ -134,7 +134,8 @@ def merge_recs(recs: Iterable[dict]) -> dict:
 def classify(workers: Dict[str, dict], straggler_factor: float = 2.0,
              retry_threshold: int = 1,
              dominance: float = DOMINANCE_SHARE,
-             resizing: bool = False) -> dict:
+             resizing: bool = False,
+             tenants: Optional[Dict[str, int]] = None) -> dict:
     """Fleet state from per-worker round records (one record per
     worker — normally each rank's latest completed round).
 
@@ -182,6 +183,23 @@ def classify(workers: Dict[str, dict], straggler_factor: float = 2.0,
         state = "sum-bound"
     else:
         state = "healthy"
+
+    # Noisy-neighbor attribution (ISSUE 9): when the fleet spans more
+    # than one tenant, split the round wall by tenant so a bound/skewed
+    # state can NAME the job that owns most of it — the multi-tenant
+    # "which neighbor is noisy" question monitor.top and the hints
+    # surface.
+    tenant_walls: Dict[str, float] = {}
+    if tenants and len(set(tenants.values())) > 1:
+        for name, rec in workers.items():
+            t = str(tenants.get(name, 0))
+            tenant_walls[t] = tenant_walls.get(t, 0.0) + round_wall_us(rec)
+    total_wall = sum(tenant_walls.values())
+    noisy = None
+    if total_wall > 0:
+        top = max(tenant_walls, key=lambda t: tenant_walls[t])
+        if tenant_walls[top] / total_wall >= 0.6:
+            noisy = top
     return {
         "state": state,
         "dominant": dom,
@@ -190,6 +208,8 @@ def classify(workers: Dict[str, dict], straggler_factor: float = 2.0,
         "stragglers": stragglers,
         "baseline_push_us": baseline,
         "retries": retries,
+        "tenant_walls": {t: round(v, 1) for t, v in tenant_walls.items()},
+        "noisy_tenant": noisy,
     }
 
 
@@ -266,25 +286,69 @@ def hints(state: str, fleet_rec: dict) -> List[str]:
     return out
 
 
+def window_recs(summary: dict, window: int) -> Dict[str, dict]:
+    """Per-worker records merged over each worker's last ``window``
+    completed rounds in the scheduler's ``fleet_rounds`` table. A
+    single round's record is pacing-sensitive (one scheduler hiccup on
+    a loaded box flips its ratios); summing a small completed-round
+    window classifies on the same share arithmetic but over a stable
+    base — the deflake contract for the straggler fleet test. Falls
+    back to each rank's ``last`` record when the table is empty or
+    ``window`` <= 1."""
+    fleet = summary.get("fleet", {}) or {}
+    last = {node: st.get("last", {}) for node, st in fleet.items()
+            if st.get("role") == 2}
+    table = summary.get("fleet_rounds", {}) or {}
+    if window <= 1 or not table:
+        return last
+    by_node: Dict[str, List[dict]] = {}
+    for rnd in sorted(table, key=int, reverse=True):
+        for node, rec in table[rnd].items():
+            if node not in last:
+                continue  # non-worker rank
+            recs = by_node.setdefault(node, [])
+            if len(recs) < window:
+                recs.append(rec)
+    return {node: merge_recs(recs) for node, recs in by_node.items()} \
+        or last
+
+
 def analyze(summary: dict, straggler_factor: float = 2.0,
-            regress_factor: float = REGRESS_FACTOR) -> dict:
+            regress_factor: float = REGRESS_FACTOR,
+            window: int = 1) -> dict:
     """Full report from one ``bps_round_summary`` snapshot (normally the
     SCHEDULER's, whose ``fleet`` section holds every rank's summaries).
-    Falls back to the local ring when no fleet data is present."""
+    Falls back to the local ring when no fleet data is present.
+    ``window`` > 1 classifies over each worker's last N completed
+    rounds instead of a single pacing-sensitive one (see window_recs)."""
     fleet = summary.get("fleet", {}) or {}
-    workers = {node: st.get("last", {}) for node, st in fleet.items()
-               if st.get("role") == 2}
+    workers = window_recs(summary, window)
     local_only = False
     if not workers:
         last = summary.get("last")
         workers = {str(summary.get("node_id", -1)): last} if last else {}
         local_only = True
+    tenants = {node: int(st.get("tenant", 0))
+               for node, st in fleet.items() if st.get("role") == 2}
     rep = classify(workers, straggler_factor=straggler_factor,
-                   resizing=bool(summary.get("resizing", 0)))
+                   resizing=bool(summary.get("resizing", 0)),
+                   tenants=tenants)
     rep["regressions"] = regressions(
         {n: st for n, st in fleet.items() if st.get("role") == 2},
         factor=regress_factor)
     rep["hints"] = hints(rep["state"], rep["fleet"])
+    # Noisy-neighbor hint (ISSUE 9): name the tenant, not just the
+    # stage — on a shared fleet the actionable knob is that job's
+    # BYTEPS_TENANT_WEIGHT (or its own pacing), not a fleet-wide one.
+    if rep.get("noisy_tenant") is not None:
+        walls = rep.get("tenant_walls", {})
+        total = sum(walls.values()) or 1.0
+        share = walls.get(rep["noisy_tenant"], 0.0) / total
+        rep["hints"].append(
+            "tenant %s owns %.0f%% of the fleet round wall -> the "
+            "noisy neighbor; rebalance BYTEPS_TENANT_WEIGHT or pace "
+            "that job before touching fleet-wide knobs"
+            % (rep["noisy_tenant"], share * 100))
     rep["local_only"] = local_only
     rep["workers"] = workers
     rep["rounds_seen"] = sorted(
@@ -366,6 +430,10 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true",
                    help="machine-readable report (one JSON object per "
                         "poll)")
+    p.add_argument("--window", type=int, default=1,
+                   help="classify over each worker's last N completed "
+                        "rounds instead of only the latest (stable "
+                        "under scheduler-noise; default 1)")
     args = p.parse_args(argv)
 
     endpoint = args.endpoint or "%s:%s" % (
@@ -381,7 +449,8 @@ def main(argv=None) -> int:
                 return 1
             time.sleep(args.watch)
             continue
-        rep = analyze(summary, straggler_factor=args.straggler_factor)
+        rep = analyze(summary, straggler_factor=args.straggler_factor,
+                      window=args.window)
         if args.json:
             rep2 = dict(rep)
             print(json.dumps(rep2))

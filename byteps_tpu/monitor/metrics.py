@@ -117,6 +117,39 @@ def prometheus_text(snap: Optional[dict] = None) -> str:
     scalar("bps_queue_credit_budget_bytes", "gauge",
            queue.get("credit_budget_bytes", 0))
 
+    # Multi-tenant series (ISSUE 9): one labeled sample per tenant from
+    # the accounting registry + the address-book roster (scheduler).
+    tenants = snap.get("tenants", {}) or {}
+    stats = tenants.get("stats", {}) or {}
+    if stats:
+        for metric, kind in (("bps_tenant_push_bytes_total", "counter"),
+                             ("bps_tenant_reply_bytes_total", "counter"),
+                             ("bps_tenant_ops_total", "counter"),
+                             ("bps_tenant_sum_us_total", "counter"),
+                             ("bps_tenant_dispatched_total", "counter"),
+                             ("bps_tenant_queue_depth", "gauge"),
+                             ("bps_tenant_starve_us", "gauge")):
+            field = metric.replace("bps_tenant_", "").replace("_total",
+                                                              "")
+            field = {"push_bytes": "push_bytes",
+                     "reply_bytes": "reply_bytes", "ops": "ops",
+                     "sum_us": "sum_us", "dispatched": "dispatched",
+                     "queue_depth": "queue_depth",
+                     "starve_us": "starve_us"}[field]
+            lines.append(f"# TYPE {metric} {kind}")
+            for tid in sorted(stats, key=int):
+                lines.append(
+                    f'{metric}{{tenant="{tid}"}} '
+                    f'{_fmt(stats[tid].get(field, 0))}')
+    roster = tenants.get("roster", {}) or {}
+    if roster:
+        for metric, field in (("bps_tenant_workers", "workers"),
+                              ("bps_tenant_weight", "weight")):
+            lines.append(f"# TYPE {metric} gauge")
+            for tid in sorted(roster, key=int):
+                lines.append(f'{metric}{{tenant="{tid}"}} '
+                             f'{_fmt(roster[tid].get(field, 0))}')
+
     ages = snap.get("heartbeat_age_ms", {})
     if ages:
         lines.append("# TYPE bps_heartbeat_age_ms gauge")

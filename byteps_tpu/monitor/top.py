@@ -64,6 +64,67 @@ def _sample(metrics: dict, name: str, default: float = 0.0) -> float:
     return next(iter(series.values()))
 
 
+def _rank_key(name: str):
+    """Numeric rank ordering for role rows: worker10 sorts after
+    worker2, not between worker1 and worker2 (stable scripting order
+    for --json consumers and the tenant fleet tests)."""
+    head = name.rstrip("0123456789")
+    tail = name[len(head):]
+    return (head, int(tail) if tail else -1)
+
+
+def _tenant_rows(scrapes: Dict[str, Optional[dict]],
+                 starve_ms: float = 2000.0) -> Dict[str, dict]:
+    """Per-tenant accounting aggregated across the SERVER scrapes
+    (bps_tenant_* labeled series, ISSUE 9) plus worker count / weight
+    from any role that carries the address-book roster gauges. A
+    tenant is STARVED when any server reports queued work unserved for
+    longer than BYTEPS_TENANT_STARVE_MS."""
+    rows: Dict[str, dict] = {}
+
+    def row(tid: str) -> dict:
+        return rows.setdefault(tid, {
+            "push_bytes": 0, "reply_bytes": 0, "ops": 0,
+            "queue_depth": 0, "dispatched": 0, "starve_us": 0,
+            "workers": 0, "weight": 0, "starved": False,
+        })
+
+    for name, m in scrapes.items():
+        if m is None:
+            continue
+        is_server = name.startswith("server")
+        for metric, field in (("bps_tenant_push_bytes_total",
+                               "push_bytes"),
+                              ("bps_tenant_reply_bytes_total",
+                               "reply_bytes"),
+                              ("bps_tenant_ops_total", "ops"),
+                              ("bps_tenant_queue_depth", "queue_depth"),
+                              ("bps_tenant_dispatched_total",
+                               "dispatched"),
+                              ("bps_tenant_starve_us", "starve_us")):
+            if not is_server:
+                continue  # engine accounting lives on servers
+            for labels, v in (m.get(metric) or {}).items():
+                tid = dict(labels).get("tenant")
+                if tid is None:
+                    continue
+                r = row(tid)
+                if field == "starve_us":
+                    r[field] = max(r[field], int(v))
+                else:
+                    r[field] += int(v)
+        for metric, field in (("bps_tenant_workers", "workers"),
+                              ("bps_tenant_weight", "weight")):
+            for labels, v in (m.get(metric) or {}).items():
+                tid = dict(labels).get("tenant")
+                if tid is not None:
+                    row(tid)[field] = max(row(tid)[field], int(v))
+    for r in rows.values():
+        r["starved"] = (r["queue_depth"] > 0
+                        and r["starve_us"] / 1000.0 > starve_ms)
+    return rows
+
+
 def analyze(scrapes: Dict[str, Optional[dict]],
             straggler_factor: float = 2.0,
             heartbeat_timeout_s: float = 30.0) -> dict:
@@ -144,10 +205,12 @@ def analyze(scrapes: Dict[str, Optional[dict]],
     # A worker actively riding the retry layer is flagged separately
     # from stragglers: its latency may still look healthy while its
     # connection quality is not.
-    retrying = sorted(n for n, w in workers.items()
-                      if w["retries"] > 0 or w["reconnects"] > 0)
-    trace_dropping = sorted(n for n, w in workers.items()
-                            if w["trace_dropped"] > 0)
+    retrying = sorted((n for n, w in workers.items()
+                       if w["retries"] > 0 or w["reconnects"] > 0),
+                      key=_rank_key)
+    trace_dropping = sorted((n for n, w in workers.items()
+                             if w["trace_dropped"] > 0),
+                            key=_rank_key)
 
     stragglers: List[str] = []
     active = {n: w["push_mean_us"] for n, w in workers.items()
@@ -203,15 +266,26 @@ def analyze(scrapes: Dict[str, Optional[dict]],
     elif resizing:
         fleet_state = "resizing"
 
+    import os as _os
+    tenants = _tenant_rows(
+        scrapes,
+        starve_ms=float(_os.environ.get("BYTEPS_TENANT_STARVE_MS",
+                                        "2000") or 2000))
+
     return {
         "workers": workers,
+        # Multi-tenant rows (ISSUE 9; docs/multitenancy.md).
+        "tenants": tenants,
+        "starved_tenants": sorted(
+            (t for t, r in tenants.items() if r["starved"]), key=int),
         "baseline_push_us": baseline_us,
-        "stragglers": sorted(stragglers),
+        "stragglers": sorted(stragglers, key=_rank_key),
         "retrying": retrying,
         "trace_dropping": trace_dropping,
         "stale_nodes": sorted(stale_nodes),
         "dead_nodes": sorted(dead_nodes),
-        "unreachable": sorted(n for n, m in scrapes.items() if m is None),
+        "unreachable": sorted((n for n, m in scrapes.items()
+                               if m is None), key=_rank_key),
         # Hot-replacement fleet state (docs/monitoring.md "Recovery").
         "epoch": epoch,
         "recovering": recovering,
@@ -252,7 +326,22 @@ def _print_report(report: dict, as_json: bool) -> None:
         print(f"fleet: {report['fleet_state'].upper()} "
               f"(round bottleneck: {report['fleet_bottleneck']}; "
               "details: python -m byteps_tpu.monitor.insight)")
-    for name in sorted(report["workers"]):
+    tenants = report.get("tenants") or {}
+    # Tenant rows only when some job actually registered a tenant (a
+    # legacy fleet's single implicit tenant 0 row would be noise).
+    if any(t != "0" for t in tenants):
+        print(f"{'tenant':<10} {'weight':>6} {'workers':>7} "
+              f"{'push MB':>9} {'reply MB':>9} {'ops':>8} {'queued':>6} "
+              f"{'served MB':>9} flags")
+        for tid in sorted(tenants, key=int):
+            r = tenants[tid]
+            flags = "STARVED" if r["starved"] else ""
+            print(f"t{tid:<9} {r['weight']:>6} {r['workers']:>7} "
+                  f"{r['push_bytes'] / 1e6:>9.2f} "
+                  f"{r['reply_bytes'] / 1e6:>9.2f} {r['ops']:>8} "
+                  f"{r['queue_depth']:>6} "
+                  f"{r['dispatched'] / 1e6:>9.2f} {flags}")
+    for name in sorted(report["workers"], key=_rank_key):
         w = report["workers"][name]
         flags = []
         if name in report["stragglers"]:
@@ -279,7 +368,8 @@ def _print_report(report: dict, as_json: bool) -> None:
               f"{credit:>14} {w.get('retries', 0):>5} "
               f"{w.get('reconnects', 0):>6} {bneck:>14} "
               f"{' '.join(flags)}")
-    for kind in ("retrying", "stale_nodes", "dead_nodes", "unreachable"):
+    for kind in ("retrying", "stale_nodes", "dead_nodes", "unreachable",
+                 "starved_tenants"):
         if report.get(kind):
             print(f"{kind}: {report[kind]}")
 

@@ -1,0 +1,188 @@
+"""Worker-side driver for the multi-tenant PS fleet tests (ISSUE 9).
+
+Runs as one worker process of ONE JOB (= one tenant) that may share its
+scheduler/server fleet with other jobs. Deterministic data comes from
+the JOB-LOCAL rank (BPS_TENANT_JOB_RANK) and the job's data seed
+(BPS_TENANT_DATA_SEED), never from the global worker rank — so a job's
+digests are comparable between a solo fleet and a shared one.
+
+Modes (BPS_TEST_MODE):
+
+- ``rounds``: broadcast an init tensor from the job's root, then run
+  BPS_TENANT_ROUNDS sync mean rounds over BPS_TENANT_KEYS tensors,
+  asserting every aggregate equals the NumPy mean over the JOB's
+  workers, and print a sha256 digest of all pulled aggregates — the
+  solo-vs-shared bit-identity oracle. Every job declares the SAME
+  tensor names (colliding tids), so any cross-tenant aliasing breaks
+  the digest.
+- ``flood``: pipeline-depth-2 rounds until BPS_TENANT_STOP_FILE
+  appears (the weighted-split contention load; correctness of each
+  aggregate still asserted).
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from byteps_tpu.core import Worker
+
+
+def _env_int(name, dflt):
+    v = os.environ.get(name)
+    return int(v) if v else dflt
+
+
+def main() -> int:
+    mode = os.environ.get("BPS_TEST_MODE", "rounds")
+    job_rank = _env_int("BPS_TENANT_JOB_RANK", 0)
+    job_size = _env_int("BPS_TENANT_JOB_SIZE", 1)
+    data_seed = _env_int("BPS_TENANT_DATA_SEED", 1234)
+    rounds = _env_int("BPS_TENANT_ROUNDS", 5)
+    keys = _env_int("BPS_TENANT_KEYS", 4)
+    n = _env_int("BPS_TENANT_N", 2048)
+    root_rank = _env_int("BPS_TENANT_ROOT", 0)  # GLOBAL worker rank
+    stop_file = os.environ.get("BPS_TENANT_STOP_FILE", "")
+
+    w = Worker.start()
+    digest = hashlib.sha256()
+
+    # Same tensor names in every job: the (tenant, key) namespace is
+    # what keeps these from aliasing server-side.
+    tids = [w.declare(f"tt_{k}", n, "float32", compression="")
+            for k in range(keys)]
+
+    # Job-scoped broadcast: the root's bytes land on every JOB member
+    # (and only the job's members are counted as waiters server-side).
+    binit = w.declare("tt_init", n, "float32", compression="")
+    if w.worker_rank() == root_rank:
+        barr = np.random.default_rng(data_seed).standard_normal(n) \
+            .astype(np.float32)
+    else:
+        barr = np.zeros(n, dtype=np.float32)
+    w.wait(w.broadcast(binit, barr, root_rank=root_rank))
+    ref = np.random.default_rng(data_seed).standard_normal(n) \
+        .astype(np.float32)
+    np.testing.assert_array_equal(barr, ref)
+    digest.update(barr.tobytes())
+
+    def round_data(k: int, rnd: int, jr: int) -> np.ndarray:
+        rng = np.random.default_rng(data_seed + 7919 * k + 104729 * rnd)
+        base = rng.standard_normal(n).astype(np.float32)
+        return (base * np.float32(jr + 1)).astype(np.float32)
+
+    def expect_mean(k: int, rnd: int) -> np.ndarray:
+        tot = np.zeros(n, dtype=np.float32)
+        for jr in range(job_size):
+            tot = tot + round_data(k, rnd, jr)
+        return tot / np.float32(job_size)
+
+    done_rounds = 0
+    try:
+        if mode == "rounds":
+            for rnd in range(rounds):
+                arrs, handles = [], []
+                for k, tid in enumerate(tids):
+                    arr = np.ascontiguousarray(round_data(k, rnd,
+                                                          job_rank))
+                    arrs.append(arr)
+                    handles.append(w.push_pull(tid, arr, average=True))
+                for k, h in enumerate(handles):
+                    w.wait(h)
+                    np.testing.assert_allclose(
+                        arrs[k], expect_mean(k, rnd), rtol=1e-6,
+                        atol=1e-7)
+                    digest.update(arrs[k].tobytes())
+                done_rounds += 1
+        elif mode == "flood":
+            # Continuous offered load until the stop file appears. The
+            # keys are split into two groups double-buffered against
+            # each other: while group A's burst is being served, group
+            # B's next burst is already queued — so this tenant's
+            # engine lane never idles between rounds (a sync round's
+            # completion gap would otherwise hand the other tenant
+            # free capacity and skew the measured split). Each KEY
+            # still has exactly one chain outstanding at a time, so
+            # the retry dedup window's one-chain-per-(key, sender)
+            # contract holds under chaos (PR 3).
+            cycle = 4
+            data = [[round_data(k, c, job_rank) for c in range(cycle)]
+                    for k in range(len(tids))]
+            expect = [[expect_mean(k, c) for c in range(cycle)]
+                      for k in range(len(tids))]
+            half = max(1, len(tids) // 2)
+            groups = [list(range(half)), list(range(half, len(tids)))]
+
+            def issue(group, rnd):
+                arrs, handles = [], []
+                for k in groups[group]:
+                    # Fresh copy: push_pull writes the aggregate back
+                    # in place, and the cached round data must survive.
+                    arr = data[k][rnd % cycle].copy()
+                    arrs.append(arr)
+                    handles.append(w.push_pull(tids[k], arr,
+                                               average=True))
+                return arrs, handles
+
+            def settle(group, rnd, arrs, handles, check):
+                for i, h in enumerate(handles):
+                    w.wait(h)
+                    if check:
+                        k = groups[group][i]
+                        np.testing.assert_allclose(
+                            arrs[i], expect[k][rnd % cycle], rtol=1e-6,
+                            atol=1e-7)
+
+            rnd = [0, 0]
+            inflight = [issue(0, 0), None]
+            rnd[0] = 1
+            while True:
+                for g in (0, 1):
+                    if inflight[g] is None:
+                        inflight[g] = issue(g, rnd[g])
+                        rnd[g] += 1
+                        continue
+                    other = 1 - g
+                    if inflight[other] is None:
+                        inflight[other] = issue(other, rnd[other])
+                        rnd[other] += 1
+                    arrs, handles = inflight[g]
+                    settle(g, rnd[g] - 1, arrs, handles,
+                           check=(rnd[g] - 1) % 8 == 0)
+                    inflight[g] = None
+                    done_rounds += 1
+                if stop_file and os.path.exists(stop_file):
+                    break
+            for g in (0, 1):
+                if inflight[g] is not None:
+                    arrs, handles = inflight[g]
+                    settle(g, rnd[g] - 1, arrs, handles, check=True)
+        else:
+            print(f"unknown BPS_TEST_MODE {mode!r}", file=sys.stderr)
+            return 2
+
+        # One /tenants poll from job rank 0 when monitoring is on (the
+        # parent test reads the server endpoints itself; this is the
+        # worker-side identity check).
+        from byteps_tpu.core.ffi import tenant_summary
+        ts = tenant_summary()
+        print(json.dumps({
+            "digest": digest.hexdigest(),
+            "rounds": done_rounds,
+            "tenant": ts["local"]["id"],
+            "tenant_name": ts["local"]["name"],
+            "weight": ts["local"]["weight"],
+            "roster": ts.get("roster", {}),
+            "node_id": w.node_id,
+            "worker_rank": w.worker_rank(),
+        }), flush=True)
+    finally:
+        w.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

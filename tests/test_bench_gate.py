@@ -85,17 +85,38 @@ def test_gate_family_end_to_end(tmp_path):
 
 
 def test_check_format_catches_malformed(tmp_path):
-    (tmp_path / "BENCH_ok_r01.json").write_text(
+    (tmp_path / "BENCH_tenant_r01.json").write_text(
         json.dumps({"steps_per_s": 1.0}))
     assert bench_gate.check_format(str(tmp_path)) == []
-    (tmp_path / "BENCH_broken_r01.json").write_text("{not json")
-    (tmp_path / "BENCH_empty_r01.json").write_text("{}")
-    (tmp_path / "BENCH_nonum_r01.json").write_text(
+    (tmp_path / "BENCH_trace_r01.json").write_text("{not json")
+    (tmp_path / "BENCH_scaling_r01.json").write_text("{}")
+    (tmp_path / "BENCH_ps_r01.json").write_text(
         json.dumps({"what": "words only"}))
     bad = bench_gate.check_format(str(tmp_path))
     assert len(bad) == 3
     assert bench_gate.main(["--repo", str(tmp_path),
                             "--check-format"]) == 1
+
+
+def test_check_format_rejects_unknown_family(tmp_path):
+    """A rounded artifact outside KNOWN_FAMILIES is a LOUD failure, not
+    a silent skip: an unregistered family is never gated against
+    regressions, so a typo'd name would quietly exempt its bench
+    forever (ISSUE 9 satellite). Un-rounded artifacts (no _rNN) stay
+    exempt — they have no prior to gate against by design."""
+    (tmp_path / "BENCH_tenants_r09.json").write_text(  # typo'd family
+        json.dumps({"steps_per_s": 1.0}))
+    bad = bench_gate.check_format(str(tmp_path))
+    assert len(bad) == 1 and "unknown bench family" in bad[0], bad
+    assert "tenants" in bad[0]
+    # Same content under the registered name passes.
+    (tmp_path / "BENCH_tenants_r09.json").unlink()
+    (tmp_path / "BENCH_tenant_r09.json").write_text(
+        json.dumps({"steps_per_s": 1.0}))
+    (tmp_path / "BENCH_oneoff.json").write_text(
+        json.dumps({"steps_per_s": 1.0}))  # un-rounded: exempt
+    assert bench_gate.check_format(str(tmp_path)) == []
+    assert "tenant" in bench_gate.KNOWN_FAMILIES
 
 
 def test_in_tree_bench_artifacts_are_well_formed():

@@ -1,0 +1,469 @@
+"""Multi-tenant parameter server tests (ISSUE 9).
+
+Fast tier (no fleet): the weighted-DRR dispatch arithmetic and the
+(tenant, key) namespacing through the ``bps_tenant_probe`` FFI hook
+(modeled on ``bps_elastic_probe``), the wire-layout A/B pin (a tenant-0
+header must be byte-for-byte the pre-tenant MsgHeader), and the config
+validation for the ``BYTEPS_TENANT_*`` knobs.
+
+Fleet tier (``tenant`` + ``ps`` markers, out of tier-1): two concurrent
+jobs with colliding tids on one shared scheduler/server fleet —
+bit-identical to their solo runs, a legacy (tenant-unset, pre-tenant
+wire) job sharing with a tenant job, and the weights-3:1 measured
+service split under chaos.
+"""
+
+import json
+import os
+import struct
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from byteps_tpu.core import ffi
+from tests.ps_utils import free_port, spawn_role, topology_env
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_tenant_worker.py")
+
+
+# --- fast: (tenant, key) namespacing ----------------------------------------
+
+def test_tenant_key_zero_is_identity():
+    """Tenant 0 composes to the bare key: a legacy fleet's store map
+    and `key % threads` engine routing are bit-for-bit unchanged."""
+    r = ffi.tenant_probe("key:0@0;key:0@77;key:0@281474976710655;"
+                         "route:0@77@4;route:0@78@4")
+    assert r["keys"][0] == 0
+    assert r["keys"][1] == 77
+    assert r["keys"][2] == (1 << 48) - 1
+    assert r["routes"] == [77 % 4, 78 % 4]
+
+
+def test_tenant_key_namespaces_are_disjoint():
+    """The same tid under different tenants composes to different
+    store keys (the no-aliasing guarantee), and every composite stays
+    a positive int64 even for tenant 65535."""
+    ids = [0, 1, 2, 7, 255, 65535]
+    script = ";".join(f"key:{t}@77" for t in ids)
+    keys = ffi.tenant_probe(script)["keys"]
+    assert len(set(keys)) == len(ids)
+    assert all(k > 0 for k in keys[1:])
+    assert all(0 < k < (1 << 63) for k in keys[1:])
+    # The tenant rides bits 47+: the bare key is recoverable.
+    for t, k in zip(ids, keys):
+        assert k & ((1 << 47) - 1) == 77
+        assert (k >> 47) & 0xFFFF == t
+
+
+# --- fast: weighted-DRR dispatch --------------------------------------------
+
+def test_drr_single_tenant_is_plain_fifo():
+    """With one active tenant the picker must be exactly a FIFO queue —
+    the dispatch-order half of the 'BYTEPS_TENANT_ID unset is
+    byte-for-byte PR 8' contract. Random enq/pop interleavings are
+    checked against a model deque."""
+    rng = np.random.default_rng(42)
+    script, model, queued = [], [], 0
+    expect = []
+    costs = list(rng.integers(1, 1 << 20, size=200))
+    ci = 0
+    for _ in range(300):
+        if queued and rng.random() < 0.5:
+            script.append("pop:1")
+            expect.append(model.pop(0))
+            queued -= 1
+        elif ci < len(costs):
+            c = int(costs[ci])
+            ci += 1
+            script.append(f"enq:5@{c}")
+            model.append(c)
+            queued += 1
+    script.append(f"pop:{queued}")
+    expect.extend(model)
+    r = ffi.tenant_probe(";".join(script))
+    assert [c for _, c in r["order"]] == expect
+    assert all(t == 5 for t, _ in r["order"])
+    assert r["remaining"] == 0
+
+
+def test_drr_weighted_split_converges_to_weights():
+    """Two backlogged tenants with weights (3,1), (1,1), (5,2): served
+    cost converges to the weight ratio."""
+    for wa, wb in ((3, 1), (1, 1), (5, 2)):
+        # Pop fewer items than either lane holds: the fair-share ratio
+        # is defined over a window where BOTH lanes stay backlogged (an
+        # emptied lane rightly forfeits its share to the survivor).
+        # Quantum near the item cost keeps the DRR cycle short, so the
+        # partial-cycle truncation at the window edge stays ~1 grant.
+        script = (f"quantum:1024;weight:1={wa};weight:2={wb};"
+                  + "".join("enq:1@1000;enq:2@1000;" for _ in range(400))
+                  + "pop:300")
+        served = ffi.tenant_probe(script)["served"]
+        ratio = served["1"] / served["2"]
+        assert abs(ratio - wa / wb) / (wa / wb) < 0.05, \
+            (wa, wb, served)
+
+
+def test_drr_fifo_within_each_tenant():
+    """DRR reorders BETWEEN tenants only: one tenant's items dispatch
+    in arrival order (per-(tenant, key) ordering depends on it)."""
+    script = ("quantum:1000;"
+              + "".join(f"enq:1@{100 + i};enq:2@{200 + i};"
+                        for i in range(50))
+              + "pop:100")
+    order = ffi.tenant_probe(script)["order"]
+    for t, base in ((1, 100), (2, 200)):
+        costs = [c for tt, c in order if tt == t]
+        assert costs == [base + i for i in range(50)]
+
+
+def test_drr_heavy_tenant_cannot_starve_light_one():
+    """A tenant flooding huge items never locks out a light tenant's
+    small items: within any window of heavy dispatches the light lane
+    keeps being served (the QoS guarantee, in miniature)."""
+    script = ("quantum:65536;weight:1=1;weight:2=1;"
+              + "".join("enq:1@1000000;" for _ in range(64))
+              + "".join("enq:2@1000;" for _ in range(64))
+              + "pop:128")
+    order = [t for t, _ in ffi.tenant_probe(script)["order"]]
+    # The light tenant's first dispatch happens within the first few
+    # heavy items, not after the heavy backlog drains.
+    assert 2 in order[:8], order[:16]
+    # And it is fully served well before the heavy lane's tail.
+    assert order.count(2) == 64
+
+
+def test_drr_zero_cost_control_items_dispatch():
+    """Zero-cost items (the server's internal roster/rollback markers)
+    dispatch without consuming any deficit."""
+    r = ffi.tenant_probe("quantum:1000;enq:1@0;enq:2@500;pop:2")
+    assert sorted(t for t, _ in r["order"]) == [1, 2]
+    assert r["remaining"] == 0
+
+
+# --- fast: wire-layout A/B pin ----------------------------------------------
+
+# The PR 8 MsgHeader layout: i32 cmd, i32 sender, i64 key, i32 req_id,
+# i32 dtype, i64 payload_len, i32 flags, i32 version, i64 arg0,
+# i64 arg1, i64 seq — with the default field values the probe leaves.
+def _pr8_header(cmd: int, key: int, version: int) -> bytes:
+    return struct.pack("<iiqiiqiiqqq", cmd, -1, key, -1, 0, 0, 0,
+                       version, 0, 0, 0)
+
+
+def test_tenant0_header_is_pre_tenant_bytes():
+    """The A/B contract: with tenant 0 (BYTEPS_TENANT_ID unset) every
+    frame header is byte-for-byte the PR 8 wire — the tenant field was
+    carved from cmd's always-zero high bytes."""
+    for cmd, key, version in ((5, 123, 7), (17, (1 << 40) + 3, 0),
+                              (24, 0, 2**31 - 1)):
+        got = ffi.wire_header_probe(cmd, 0, key, version)
+        assert len(got) == 64
+        assert got == _pr8_header(cmd, key, version), (cmd, key)
+
+
+def test_tenant_header_differs_only_in_carved_bytes():
+    """A nonzero tenant occupies exactly the two carved bytes (offsets
+    2..3); everything else is untouched."""
+    a = ffi.wire_header_probe(5, 0, 123, 7)
+    b = ffi.wire_header_probe(5, 513, 123, 7)
+    assert b[2:4] == struct.pack("<H", 513)
+    assert a[:2] == b[:2] and a[4:] == b[4:]
+
+
+# --- fast: config validation + summary shape --------------------------------
+
+def test_tenant_config_validation():
+    from byteps_tpu.config import Config
+
+    Config(tenant_id=7, tenant_weight=3).validate()
+    Config().validate()  # unset stays valid
+    with pytest.raises(ValueError, match="BYTEPS_TENANT_ID"):
+        Config(tenant_id=65536).validate()
+    with pytest.raises(ValueError, match="BYTEPS_TENANT_ID"):
+        Config(tenant_id=-1).validate()
+    with pytest.raises(ValueError, match="BYTEPS_TENANT_WEIGHT"):
+        Config(tenant_id=1, tenant_weight=0).validate()
+    with pytest.raises(ValueError, match="BYTEPS_TENANT_QUANTUM"):
+        Config(tenant_id=1, tenant_quantum_bytes=128).validate()
+    with pytest.warns(UserWarning, match="BYTEPS_TENANT_WEIGHT"):
+        Config(tenant_weight=4).validate()
+
+
+def test_tenant_summary_shape_no_fleet():
+    """tenant_summary works in any process state (pre-init): local
+    identity from env, an accounting map, and an (empty) roster."""
+    s = ffi.tenant_summary()
+    assert s["local"]["id"] == ffi.tenant_id()
+    assert isinstance(s["local"]["weight"], int)
+    assert isinstance(s["stats"], dict)
+    assert isinstance(s["roster"], dict)
+    assert s["quantum_bytes"] >= 1024
+
+
+# --- fleet tier -------------------------------------------------------------
+
+def _free_port_block(n: int) -> int:
+    import random
+    import socket
+
+    rng = random.Random()
+    for _ in range(50):
+        base = rng.randrange(20000, 55000)
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port block found")
+
+
+def _spawn_tenant_worker(env, rank, job, extra=None):
+    import subprocess
+    import sys
+
+    e = dict(env)
+    e["DMLC_ROLE"] = "worker"
+    e["DMLC_WORKER_ID"] = str(rank)
+    e.update(job)
+    e.update(extra or {})
+    return subprocess.Popen([sys.executable, WORKER], env=e,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def _job_env(tenant, weight, job_size, data_seed, root, mode="rounds",
+             name=""):
+    env = {
+        "BPS_TEST_MODE": mode,
+        "BPS_TENANT_JOB_SIZE": str(job_size),
+        "BPS_TENANT_DATA_SEED": str(data_seed),
+        "BPS_TENANT_ROOT": str(root),
+    }
+    if tenant is not None:
+        env["BYTEPS_TENANT_ID"] = str(tenant)
+        env["BYTEPS_TENANT_WEIGHT"] = str(weight)
+        if name:
+            env["BYTEPS_TENANT_NAME"] = name
+    return env
+
+
+def _run_fleet(total_workers, servers, jobs, extra=None, timeout=180):
+    """jobs: list of (job_env, worker_ranks). Returns per-worker JSON
+    records keyed by global rank."""
+    port = free_port()
+    env = topology_env(total_workers, servers, port, extra or {})
+    procs = [("scheduler", spawn_role("scheduler", env))]
+    for _ in range(servers):
+        procs.append(("server", spawn_role("server", env)))
+    for jenv, ranks in jobs:
+        for jr, rank in enumerate(ranks):
+            je = dict(jenv)
+            je["BPS_TENANT_JOB_RANK"] = str(jr)
+            procs.append((f"worker{rank}",
+                          _spawn_tenant_worker(env, rank, je)))
+    records, failed = {}, []
+    try:
+        for name, p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            if p.returncode != 0:
+                failed.append((name, p.returncode, out))
+            if name.startswith("worker"):
+                line = [ln for ln in out.splitlines()
+                        if ln.startswith("{")]
+                if line:
+                    records[name] = json.loads(line[-1])
+    finally:
+        for _, p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    assert not failed, "\n".join(
+        f"--- {n} exited {rc} ---\n{out}" for n, rc, out in failed)
+    return records
+
+
+def _solo_digests(tenant, weight, data_seed, rounds, keys, n):
+    jenv = _job_env(tenant, weight, 2, data_seed, root=0)
+    jenv.update({"BPS_TENANT_ROUNDS": str(rounds),
+                 "BPS_TENANT_KEYS": str(keys),
+                 "BPS_TENANT_N": str(n)})
+    recs = _run_fleet(2, 2, [(jenv, [0, 1])])
+    return sorted(r["digest"] for r in recs.values())
+
+
+@pytest.mark.ps
+@pytest.mark.tenant
+def test_two_tenants_bit_identical_to_solo():
+    """The ISSUE 9 scenario core: two concurrent jobs with COLLIDING
+    tids (same tensor names) on one shared 2-server fleet are each
+    bit-identical to their solo runs — the (tenant, key) namespace
+    provably prevents aliasing, and per-tenant completion counts keep
+    every aggregate an exact mean over the job's own workers."""
+    rounds, keys, n = 5, 4, 2048
+    solo_a = _solo_digests(1, 3, data_seed=111, rounds=rounds,
+                           keys=keys, n=n)
+    solo_b = _solo_digests(2, 1, data_seed=222, rounds=rounds,
+                           keys=keys, n=n)
+
+    ja = _job_env(1, 3, 2, data_seed=111, root=0, name="jobA")
+    jb = _job_env(2, 1, 2, data_seed=222, root=2, name="jobB")
+    for j in (ja, jb):
+        j.update({"BPS_TENANT_ROUNDS": str(rounds),
+                  "BPS_TENANT_KEYS": str(keys),
+                  "BPS_TENANT_N": str(n)})
+    recs = _run_fleet(4, 2, [(ja, [0, 1]), (jb, [2, 3])])
+    shared_a = sorted(recs[f"worker{r}"]["digest"] for r in (0, 1))
+    shared_b = sorted(recs[f"worker{r}"]["digest"] for r in (2, 3))
+    assert shared_a == solo_a, "tenant 1 diverged from its solo run"
+    assert shared_b == solo_b, "tenant 2 diverged from its solo run"
+    # Identity + roster really crossed the wire.
+    assert recs["worker0"]["tenant"] == 1
+    assert recs["worker0"]["tenant_name"] == "jobA"
+    assert recs["worker2"]["tenant"] == 2
+    roster = recs["worker0"]["roster"]
+    assert roster["1"] == {"workers": 2, "weight": 3}
+    assert roster["2"] == {"workers": 2, "weight": 1}
+
+
+@pytest.mark.ps
+@pytest.mark.tenant
+def test_legacy_peer_shares_fleet_with_tenant_job():
+    """Old-format interop: a job with BYTEPS_TENANT_ID unset sends the
+    byte-for-byte PR 8 wire (tenant bytes zero) and rides the legacy
+    tenant-0 pool — sharing a fleet with a registered tenant, both
+    bit-identical to their solo runs."""
+    rounds, keys, n = 4, 3, 1536
+    solo_legacy = _solo_digests(None, 1, data_seed=333, rounds=rounds,
+                                keys=keys, n=n)
+    solo_t = _solo_digests(9, 2, data_seed=444, rounds=rounds,
+                           keys=keys, n=n)
+
+    legacy = _job_env(None, 1, 2, data_seed=333, root=0)
+    jt = _job_env(9, 2, 2, data_seed=444, root=2)
+    for j in (legacy, jt):
+        j.update({"BPS_TENANT_ROUNDS": str(rounds),
+                  "BPS_TENANT_KEYS": str(keys),
+                  "BPS_TENANT_N": str(n)})
+    recs = _run_fleet(4, 2, [(legacy, [0, 1]), (jt, [2, 3])])
+    assert sorted(recs[f"worker{r}"]["digest"]
+                  for r in (0, 1)) == solo_legacy
+    assert sorted(recs[f"worker{r}"]["digest"] for r in (2, 3)) == solo_t
+    assert recs["worker0"]["tenant"] == 0
+
+
+def _scrape_tenants(port, timeout=3.0):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/tenants",
+                                timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+@pytest.mark.ps
+@pytest.mark.tenant
+def test_weighted_split_holds_under_chaos(tmp_path):
+    """QoS acceptance: weights 3:1 on a paced 2-server engine under
+    chaos (drop/dup seed 42) — the measured per-tenant served split
+    over a contended window holds the configured ratio within ±15%.
+    Engine pacing (BYTEPS_SERVER_ENGINE_PACE_MBPS) keeps both lanes
+    genuinely backlogged on loopback; without backlog there is no
+    contention and nothing to share."""
+    stop = str(tmp_path / "stop")
+    base = _free_port_block(3)
+    extra = {
+        "BYTEPS_MONITOR_ON": "1",
+        "BYTEPS_MONITOR_PORT": str(base),
+        "BYTEPS_SERVER_ENGINE_THREAD": "1",
+        "BYTEPS_SERVER_ENGINE_PACE_MBPS": "8",
+        # Short retry timeout: the paced engine queues tens of ms of
+        # work — far under the retry clock — and a chaos-dropped frame
+        # is re-driven quickly, so a drop stalls one key group briefly
+        # instead of idling the tenant's lane.
+        "BYTEPS_RETRY_TIMEOUT_MS": "500",
+        "BYTEPS_CHAOS_SEED": "42",
+        "BYTEPS_CHAOS_DROP": "0.002",
+        "BYTEPS_CHAOS_DUP": "0.002",
+    }
+    ja = _job_env(1, 3, 2, data_seed=11, root=0, mode="flood")
+    jb = _job_env(2, 1, 2, data_seed=22, root=2, mode="flood")
+    for j in (ja, jb):
+        j.update({"BPS_TENANT_KEYS": "24", "BPS_TENANT_N": str(1 << 15),
+                  "BPS_TENANT_STOP_FILE": stop})
+
+    import subprocess  # noqa: F401 (spawned via helpers)
+
+    port = free_port()
+    env = topology_env(4, 2, port, extra)
+    procs = [("scheduler", spawn_role("scheduler", env))]
+    for _ in range(2):
+        procs.append(("server", spawn_role("server", env)))
+    for jenv, ranks in ((ja, [0, 1]), (jb, [2, 3])):
+        for jr, rank in enumerate(ranks):
+            je = dict(jenv)
+            je["BPS_TENANT_JOB_RANK"] = str(jr)
+            procs.append((f"worker{rank}",
+                          _spawn_tenant_worker(env, rank, je)))
+    try:
+        # Server monitor ports = base + node id (servers are 1 and 2).
+        sports = [base + 1, base + 2]
+
+        def dispatched():
+            out = {}
+            for p in sports:
+                doc = _scrape_tenants(p)
+                for tid, st in doc["stats"].items():
+                    out[tid] = out.get(tid, 0) + st["dispatched"]
+            return out
+
+        # Warm up until both tenants are being served, then measure a
+        # contended window.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                d = dispatched()
+            except OSError:
+                time.sleep(0.5)
+                continue
+            if d.get("1", 0) > 0 and d.get("2", 0) > 0:
+                break
+            time.sleep(0.25)
+        else:
+            raise AssertionError("tenants never both got served")
+        time.sleep(2.0)  # past the bcast/declare phase
+        d0 = dispatched()
+        time.sleep(15.0)
+        d1 = dispatched()
+        with open(stop, "w") as f:
+            f.write("stop")
+        served_a = d1["1"] - d0["1"]
+        served_b = d1["2"] - d0["2"]
+        assert served_b > 0, (d0, d1)
+        ratio = served_a / served_b
+        assert 3.0 * 0.85 <= ratio <= 3.0 * 1.15, \
+            f"measured split {ratio:.2f} vs configured 3.0 ({d0} {d1})"
+        # Starvation flag never fired for the light tenant: it kept
+        # being served throughout the contention window.
+        for p in sports:
+            doc = _scrape_tenants(p)
+            assert not doc["stats"]["2"].get("starved", False), doc
+    finally:
+        failed = []
+        for name, p in procs:
+            try:
+                out, _ = p.communicate(timeout=120)
+            except Exception:
+                p.kill()
+                out, _ = p.communicate()
+            if p.returncode != 0:
+                failed.append((name, p.returncode, out))
+        assert not failed, "\n".join(
+            f"--- {n} exited {rc} ---\n{out}" for n, rc, out in failed)

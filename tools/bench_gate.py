@@ -44,6 +44,33 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _FAMILY_RE = re.compile(r"BENCH_(?P<name>.+)_r(?P<round>\d+)\.json$")
 _CORE_RE = re.compile(r"BENCH_r(?P<round>\d+)\.json$")
 
+# Every rounded BENCH_<family>_rNN family the repo produces. A rounded
+# artifact whose family is NOT here is a --check-format failure, not a
+# silent skip: an unregistered family never gets gated, so a typo'd
+# name (BENCH_tenant_r09 vs BENCH_tenants_r09) would quietly exempt a
+# whole bench from regression checking forever. Register new families
+# here in the PR that introduces them.
+KNOWN_FAMILIES = frozenset({
+    "core",         # BENCH_rNN.json (the original resnet bench)
+    "async",
+    "bert",
+    "compression",
+    "elastic",
+    "gate",
+    "gpt2",
+    "insight",
+    "mfu_attr",
+    "overlap_bw",
+    "priority",
+    "ps",
+    "scaling",
+    "shm_van",
+    "striping",
+    "tenant",       # ISSUE 9: multi-tenant weighted-split bench
+    "trace",
+    "zerocopy",
+})
+
 # Metric direction by name token. A metric matching neither list is
 # compared but only reported (status "info") — gating on a metric whose
 # good direction is unknown would turn byte counts into failures.
@@ -156,9 +183,19 @@ def gate_family(name: str, rounds: Dict[int, str],
 
 def check_format(repo: str = REPO) -> List[str]:
     """Schema-only validation of every in-tree BENCH artifact: must
-    parse as JSON and be a non-empty object. Returns violations."""
+    parse as JSON, be a non-empty object, and — for rounded
+    BENCH_<family>_rNN artifacts — belong to a REGISTERED family
+    (KNOWN_FAMILIES), so a typo'd family name fails loudly instead of
+    silently exempting the bench from gating. Returns violations."""
     bad = []
     for p in find_bench_files(repo):
+        fam = family_of(p)
+        if fam and fam[0] not in KNOWN_FAMILIES:
+            bad.append(
+                f"{os.path.basename(p)}: unknown bench family "
+                f"{fam[0]!r} — register it in tools/bench_gate.py "
+                "KNOWN_FAMILIES (an unregistered family is never "
+                "gated against regressions)")
         try:
             with open(p) as f:
                 doc = json.load(f)
