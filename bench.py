@@ -239,6 +239,16 @@ def main() -> None:
                         "both read from the scheduler's "
                         "bps_epoch_change_ms gauge. Writes --out "
                         "(BENCH_elastic_r08.json)")
+    p.add_argument("--sched-recovery", action="store_true",
+                   help="ISSUE 15 artifact: scheduler fail-over "
+                        "park->resume pause on a live 2wx2s comm-round "
+                        "fleet — SIGKILL the scheduler mid-round, "
+                        "respawn it with DMLC_SCHED_RECOVER=1, and read "
+                        "each side of the outage: the worker's "
+                        "bps_sched_park_ms gauge (its own park->resume "
+                        "wall) and the restarted scheduler's "
+                        "bps_sched_recovery_ms (restart->quorum-commit "
+                        "wall). Writes --out (BENCH_sched_r15.json)")
     p.add_argument("--trace-overhead", action="store_true",
                    help="ISSUE 5 acceptance artifact: comm-only "
                         "small-tensor rounds over a real 2wx2s PS fleet "
@@ -267,6 +277,8 @@ def main() -> None:
         return bench_insight_overhead(args)
     if args.elastic:
         return bench_elastic(args)
+    if args.sched_recovery:
+        return bench_sched_recovery(args)
     if args.tenants:
         return bench_tenants(args)
     if args.sweep:
@@ -1214,6 +1226,178 @@ def bench_elastic(args) -> None:
                       "unit": "ms"}))
     print(json.dumps({"metric": "shrink_pause_ms", "value": shrink_ms,
                       "unit": "ms"}))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps({"artifact": args.out}))
+
+
+def bench_sched_recovery(args) -> None:
+    """Scheduler fail-over park->resume pause (ISSUE 15 artifact): on a
+    live 2wx2s comm-round fleet with fail-over armed, SIGKILL the
+    scheduler mid-round, respawn it with DMLC_SCHED_RECOVER=1, and read
+    both sides of the outage — the worker's own bps_sched_park_ms gauge
+    (heartbeat-detect -> RESUME wall on that node) and the restarted
+    scheduler's bps_sched_recovery_ms (process restart -> quorum commit).
+    The data plane keeps draining against the last committed address
+    book throughout, so rounds completed is also recorded."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    import urllib.request
+
+    from byteps_tpu.monitor.metrics import parse_prometheus
+    from tools.shaped_fleet import free_port
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    td = tempfile.mkdtemp(prefix="bps_schedrec_bench_")
+    stop_file = os.path.join(td, "stop")
+    port = free_port()
+    mport_sched = free_port()
+    mport_w0 = free_port()
+    env = dict(os.environ)
+    env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "2",
+        "DMLC_NUM_SERVER": str(args.servers),
+        "PS_HEARTBEAT_INTERVAL": "0.5",
+        "PS_HEARTBEAT_TIMEOUT": "2",
+        "BYTEPS_SCHED_RECOVERY_TIMEOUT_MS": "30000",
+        "BYTEPS_RETRY_TIMEOUT_MS": "300",
+        "BYTEPS_RECONNECT_BACKOFF_MS": "50",
+        "BPS_BENCH_STOP_FILE": stop_file,
+        "PYTHONPATH": repo,
+    })
+
+    def spawn_role(role, extra=None):
+        e = dict(env)
+        e["DMLC_ROLE"] = role
+        e.update(extra or {})
+        return subprocess.Popen(
+            [sys.executable, "-m", "byteps_tpu.server"], env=e)
+
+    def scrape(mp):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{mp}/metrics", timeout=2) as r:
+                return parse_prometheus(r.read().decode())
+        except (OSError, ValueError):
+            return None
+
+    def sample(mp, name):
+        series = (scrape(mp) or {}).get(name)
+        return next(iter(series.values())) if series else None
+
+    def wait_sample(mp, name, pred, timeout_s=60.0, what=""):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            v = sample(mp, name)
+            if v is not None and pred(v):
+                return v
+            time.sleep(0.05)
+        raise SystemExit(f"timeout waiting for {what or name} on "
+                         f"monitor port {mp}")
+
+    procs = []
+    try:
+        sched = spawn_role("scheduler", {
+            "BYTEPS_MONITOR_ON": "1",
+            "BYTEPS_MONITOR_PORT": str(mport_sched)})
+        procs.append(sched)
+        for _ in range(args.servers):
+            procs.append(spawn_role("server"))
+
+        def spawn_member(idx, extra=None):
+            e = dict(env)
+            e["DMLC_ROLE"] = "worker"
+            e["DMLC_WORKER_ID"] = str(idx)
+            e.update(extra or {})
+            return subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--role", "elastic_member_worker"],
+                env=e, stdout=subprocess.PIPE, text=True)
+
+        # The monitor binds BYTEPS_MONITOR_PORT + node_id; worker 0's
+        # node id is 1 + num_servers (scheduler 0, servers 1..S), so
+        # hand it a base that lands its endpoint on the free port.
+        w0_id = 1 + args.servers
+        workers = [
+            spawn_member(0, {"BYTEPS_MONITOR_ON": "1",
+                             "BYTEPS_MONITOR_PORT": str(mport_w0 - w0_id)}),
+            spawn_member(1),
+        ]
+        procs += workers
+        wait_sample(mport_sched, "bps_fleet_workers", lambda v: v == 2,
+                    what="fleet assembly")
+        time.sleep(1.5)  # steady-state rounds
+
+        t_kill = time.time()
+        sched.kill()
+        sched.wait()
+        wait_sample(mport_w0, "bps_sched_lost", lambda v: v == 1,
+                    what="worker 0 park (bps_sched_lost)")
+        detect_s = time.time() - t_kill
+        time.sleep(1.0)  # supervisor respawn delay stand-in
+        sched2 = spawn_role("scheduler", {
+            "DMLC_SCHED_RECOVER": "1",
+            "BYTEPS_MONITOR_ON": "1",
+            "BYTEPS_MONITOR_PORT": str(mport_sched)})
+        procs.append(sched2)
+        wait_sample(mport_w0, "bps_sched_recoveries_total",
+                    lambda v: v >= 1, what="worker 0 resume")
+        kill_to_resume_s = time.time() - t_kill
+        park_ms = sample(mport_w0, "bps_sched_park_ms")
+        sched_rebuild_ms = sample(mport_sched, "bps_sched_recovery_ms")
+
+        time.sleep(1.0)  # post-recovery rounds keep flowing
+        with open(stop_file, "w") as f:
+            f.write("stop\n")
+        rounds = 0
+        for wp in workers:
+            out, _ = wp.communicate(timeout=120)
+            if wp.returncode != 0:
+                raise SystemExit(f"fleet member failed:\n{out}")
+            for ln in out.splitlines():
+                if ln.startswith("{"):
+                    rounds = max(rounds, json.loads(ln).get("rounds", 0))
+        for pr in procs[1:1 + args.servers] + [sched2]:
+            pr.wait(timeout=60)
+    finally:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.kill()
+    doc = {
+        "what": ("scheduler fail-over park->resume pause on a live "
+                 "2wx2s comm-round fleet (ISSUE 15): SIGKILL the "
+                 "scheduler mid-round, respawn with "
+                 "DMLC_SCHED_RECOVER=1 after a 1 s supervisor-delay "
+                 "stand-in. park_to_resume_ms is worker 0's own "
+                 "bps_sched_park_ms gauge (heartbeat detect -> RESUME); "
+                 "sched_rebuild_ms is the restarted scheduler's "
+                 "bps_sched_recovery_ms (restart -> quorum commit); "
+                 "observed walls are parent-side poll-bound. The data "
+                 "plane drains against the last committed address book "
+                 "for the whole outage (rounds_completed_max keeps "
+                 "growing through it)"),
+        "workers": 2,
+        "servers": args.servers,
+        "respawn_delay_s": 1.0,
+        "summary": {
+            "park_to_resume_ms": park_ms,
+            "sched_rebuild_ms": sched_rebuild_ms,
+            "detect_observed_wall_s": round(detect_s, 3),
+            "kill_to_resume_observed_wall_s": round(kill_to_resume_s, 3),
+            "rounds_completed_max": rounds,
+        },
+    }
+    print(json.dumps({"metric": "park_to_resume_ms", "value": park_ms,
+                      "unit": "ms"}))
+    print(json.dumps({"metric": "sched_rebuild_ms",
+                      "value": sched_rebuild_ms, "unit": "ms"}))
+    if park_ms is None or park_ms >= 10000:
+        raise SystemExit(f"park->resume pause not sub-10s: {park_ms}")
     if args.out:
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=1)

@@ -185,6 +185,22 @@ class Config:
     #   fail-stop fallback window: a membership change that cannot
     #   commit (a worker never acks the join gate) falls back to the
     #   failure SHUTDOWN after this long
+    # --- scheduler fail-over (ISSUE 15; docs/troubleshooting.md) -----------
+    sched_recovery_timeout_ms: int = 0    # BYTEPS_SCHED_RECOVERY_TIMEOUT_MS
+    #   scheduler crash-restart window: a node losing its scheduler
+    #   connection PARKS (data plane keeps draining against the last
+    #   committed address book) and re-dials the scheduler endpoint for
+    #   this long before escalating to the old fail-stop; a restarted
+    #   scheduler (DMLC_SCHED_RECOVER) waits this long for the fleet's
+    #   re-registration quorum. 0 (default) keeps the scheduler-lost
+    #   fail-stop contract byte for byte. Requires the retry layer AND
+    #   heartbeats (the failed beat is the loss detector; the rebuilt
+    #   death table needs commit-time seeds)
+    sched_recover: bool = False           # DMLC_SCHED_RECOVER
+    #   scheduler-process only: this incarnation is a crash-restart —
+    #   rebuild all control-plane state from re-registrations instead
+    #   of forming a fleet (set by the supervisor when respawning a
+    #   dead scheduler role)
     join_fleet: bool = False              # DMLC_JOIN
     #   worker-process only: join a RUNNING fleet instead of taking part
     #   in formation (set by the launcher's elastic scale-up / a
@@ -227,6 +243,11 @@ class Config:
     #   fixed extra latency per data-plane frame
     chaos_reset_every: int = 0            # BYTEPS_CHAOS_RESET_EVERY
     #   force a connection reset every N data-plane frames (0 disables)
+    chaos_ctrl: bool = False              # BYTEPS_CHAOS_CTRL
+    #   opt-in: let the drop/dup/delay/reset dice also hit CONTROL-plane
+    #   frames (heartbeats, membership, scheduler traffic). Requires
+    #   scheduler recovery armed — a control-plane drop with no recovery
+    #   path is just a slow fail-stop, not a test of anything
 
     # --- TPU-specific (new scope; no reference equivalent) -----------------
     ici_axis: str = "ici"                 # mesh axis name for intra-slice
@@ -258,6 +279,15 @@ class Config:
         BYTEPS_RECOVERY_TIMEOUT_MS=0 to be set separately. This value —
         not the raw knob — is what ffi projects to the C core."""
         return 0 if self.retry_max == 0 else self.recovery_timeout_ms
+
+    @property
+    def effective_sched_recovery_timeout_ms(self) -> int:
+        """Scheduler fail-over window the fleet actually runs with. The
+        park path rides the same retry/reconnect machinery as hot server
+        replacement, so BYTEPS_RETRY_MAX=0 implies scheduler recovery
+        off too. This value — not the raw knob — is what ffi projects
+        to the C core."""
+        return 0 if self.retry_max == 0 else self.sched_recovery_timeout_ms
 
     @property
     def use_ps(self) -> bool:
@@ -498,6 +528,65 @@ class Config:
                     f"DMLC_RECOVER_RANK={self.recover_rank} out of range: "
                     f"the fleet has {self.num_server} server rank(s) "
                     f"(valid: 0..{max(self.num_server - 1, 0)})")
+        if self.sched_recovery_timeout_ms < 0:
+            raise ValueError(
+                "BYTEPS_SCHED_RECOVERY_TIMEOUT_MS must be >= 0 (0 "
+                "disables scheduler fail-over; a dead scheduler then "
+                "fail-stops the fleet as before)")
+        if self.sched_recovery_timeout_ms > 0:
+            if self.retry_max == 0:
+                raise ValueError(
+                    "BYTEPS_SCHED_RECOVERY_TIMEOUT_MS requires the retry "
+                    "layer (BYTEPS_RETRY_MAX > 0): parked nodes keep the "
+                    "data plane draining through the outage, and only the "
+                    "retry/dedup machinery makes the in-flight rounds "
+                    "exact across the scheduler restart")
+            if self.heartbeat_interval_s <= 0:
+                raise ValueError(
+                    "BYTEPS_SCHED_RECOVERY_TIMEOUT_MS requires heartbeats "
+                    "(PS_HEARTBEAT_INTERVAL > 0): the failed heartbeat is "
+                    "how a node detects the scheduler is gone, and the "
+                    "restarted scheduler seeds its death table from the "
+                    "re-registration commit")
+            if self.sched_recovery_timeout_ms \
+                    <= self.heartbeat_timeout_s * 1000.0:
+                raise ValueError(
+                    f"BYTEPS_SCHED_RECOVERY_TIMEOUT_MS "
+                    f"({self.sched_recovery_timeout_ms}) must exceed "
+                    f"PS_HEARTBEAT_TIMEOUT ({self.heartbeat_timeout_s}s): "
+                    "every surviving node needs at least one failed "
+                    "heartbeat round trip just to NOTICE the crash, so a "
+                    "shorter window can only ever expire into the "
+                    "fail-stop fallback")
+        if self.sched_recover:
+            if self.effective_sched_recovery_timeout_ms == 0:
+                raise ValueError(
+                    "DMLC_SCHED_RECOVER is set but scheduler fail-over is "
+                    "disabled (BYTEPS_SCHED_RECOVERY_TIMEOUT_MS=0, or "
+                    "BYTEPS_RETRY_MAX=0 — the park path rides the resend "
+                    "queue, so retry off implies recovery off) — the "
+                    "fleet would never re-register with this incarnation")
+            if self.role != "scheduler":
+                raise ValueError(
+                    "DMLC_SCHED_RECOVER is a scheduler-process knob (a "
+                    "crash-restarted scheduler rebuilding state from the "
+                    f"fleet); role is {self.role!r}")
+        if self.chaos_ctrl:
+            if not chaos_on:
+                import warnings
+                warnings.warn(
+                    "BYTEPS_CHAOS_CTRL=1 with no chaos dice armed "
+                    "(BYTEPS_CHAOS_DROP/_DUP/_RESET_EVERY all zero): the "
+                    "control-plane opt-in has nothing to inject",
+                    stacklevel=2)
+            if self.effective_sched_recovery_timeout_ms == 0:
+                raise ValueError(
+                    "BYTEPS_CHAOS_CTRL extends fault injection to "
+                    "control-plane frames (heartbeats, membership, "
+                    "scheduler traffic); it requires scheduler fail-over "
+                    "armed (BYTEPS_SCHED_RECOVERY_TIMEOUT_MS > 0 and "
+                    "BYTEPS_RETRY_MAX > 0) — a control-plane drop with no "
+                    "recovery path is just a slow fail-stop")
         if self.elastic and self.retry_max == 0:
             raise ValueError(
                 "BYTEPS_ELASTIC requires the retry layer "
@@ -622,6 +711,9 @@ def load_config() -> Config:
         recovery_timeout_ms=_env_int("BYTEPS_RECOVERY_TIMEOUT_MS", 60000),
         recover_rank=(int(os.environ["DMLC_RECOVER_RANK"])
                       if os.environ.get("DMLC_RECOVER_RANK") else None),
+        sched_recovery_timeout_ms=_env_int(
+            "BYTEPS_SCHED_RECOVERY_TIMEOUT_MS", 0),
+        sched_recover=_env_bool("DMLC_SCHED_RECOVER"),
         elastic=_env_bool("BYTEPS_ELASTIC"),
         elastic_timeout_ms=_env_int("BYTEPS_ELASTIC_TIMEOUT_MS", 30000),
         join_fleet=_env_bool("DMLC_JOIN"),
@@ -639,6 +731,7 @@ def load_config() -> Config:
         chaos_dup=float(os.environ.get("BYTEPS_CHAOS_DUP", "0") or 0),
         chaos_delay_us=_env_int("BYTEPS_CHAOS_DELAY_US", 0),
         chaos_reset_every=_env_int("BYTEPS_CHAOS_RESET_EVERY", 0),
+        chaos_ctrl=_env_bool("BYTEPS_CHAOS_CTRL"),
         ici_axis=_env_str("BYTEPS_ICI_AXIS", "ici"),
         dcn_axis=_env_str("BYTEPS_DCN_AXIS", "dcn"),
         ps_mode=_env_str("BYTEPS_PS_MODE", "auto").lower(),

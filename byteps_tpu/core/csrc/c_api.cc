@@ -191,6 +191,14 @@ int bps_init(int role) {
           (void)affected;
           gl->worker->OnFleetResume(kind, jr, jb);
         });
+    // Scheduler fail-over (ISSUE 15): the CMD_REREGISTER a parked
+    // worker sends carries its rounds-completed watermark, and a
+    // committed recovery lifts any round gate a pre-crash FLEET_PAUSE
+    // left armed (its membership op died with the old scheduler).
+    gl->po->SetRoundWatermarkProvider(
+        [gl]() -> int64_t { return gl->worker->MaxIssuedRound(); });
+    gl->po->SetSchedRecoveredCallback(
+        [gl] { gl->worker->OnSchedRecovered(); });
     // The worker pipeline exists BEFORE the postoffice starts (same
     // reasoning as the server's engine threads above): recovery
     // callbacks fire on van threads and must always find a live
@@ -512,6 +520,199 @@ long long bps_elastic_probe(const char* script, char* buf,
     }
   }
   out += "]}";
+  const long long need = static_cast<long long>(out.size());
+  if (buf && maxlen > 0) {
+    long long n = need < maxlen - 1 ? need : maxlen - 1;
+    memcpy(buf, out.data(), static_cast<size_t>(n));
+    buf[n] = '\0';
+  }
+  return need;
+}
+
+// Scheduler fail-over reconstruction probe (ISSUE 15): drives the
+// standalone SchedRecovery arithmetic — quorum counting, epoch
+// max-adoption, split-brain conflict, rank high-water mark, tenant
+// roster rebuild, heartbeat seeding, window expiry — with NO fleet.
+// Script: `;`-separated ops:
+//   servers:2         fleet has 2 server ranks (NextWorkerId base)
+//   book:1,2,3,4      the TEMPLATE address book for later reports (ids;
+//                     scheduler 0 auto-included; 1..servers = servers,
+//                     the rest workers). Change it between reports to
+//                     fabricate a same-epoch conflict.
+//   tenant:5=2        template: worker id 5 belongs to tenant 2
+//   report:3@7        node 3 re-registers at epoch 7 with the current
+//                     template book. Optional `,hint,rounds` suffix:
+//                     report:3@7,9,120
+//   window:0,5000,4000  evaluate Expired(now=5000, start=0, win=4000)
+//   seed:1000,2000    evaluate SeedHeartbeats(commit=1000) and
+//                     EarliestDeathMs with timeout=2000
+// Output: {"reregistered":N,"expected":[ids],"quorum":b,"conflict":b,
+//          "epoch":E,"next_worker":id,"rosters":{"t":[ids],...},
+//          "rounds":W,"book":[ids],"expired":b,"seeds":N,
+//          "seed_min":ms,"earliest_death":ms}. Returns the JSON
+// length (call again with a bigger buffer if it exceeds maxlen), or
+// -1 on a malformed script.
+long long bps_sched_probe(const char* script, char* buf,
+                          long long maxlen) {
+  if (!script) return -1;
+  SchedRecovery rec;
+  int num_servers = 1;
+  std::vector<NodeInfo> tmpl;
+  std::map<int, int> tenants;
+  bool expired = false;
+  int64_t seed_commit = -1, seed_timeout = 0;
+  const std::string s(script);
+  auto make_book = [&]() {
+    std::vector<NodeInfo> out;
+    NodeInfo sched{};
+    sched.id = kSchedulerId;
+    sched.role = ROLE_SCHEDULER;
+    out.push_back(sched);
+    for (const auto& n : tmpl) out.push_back(n);
+    return out;
+  };
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t end = s.find(';', pos);
+    if (end == std::string::npos) end = s.size();
+    const std::string tok = s.substr(pos, end - pos);
+    pos = end + 1;
+    if (tok.empty()) continue;
+    const size_t colon = tok.find(':');
+    const std::string op = tok.substr(0, colon);
+    const std::string val =
+        colon == std::string::npos ? "" : tok.substr(colon + 1);
+    if (op == "servers") {
+      num_servers = atoi(val.c_str());
+    } else if (op == "book") {
+      tmpl.clear();
+      size_t p = 0;
+      while (p < val.size()) {
+        size_t c = val.find(',', p);
+        if (c == std::string::npos) c = val.size();
+        const int id = atoi(val.substr(p, c - p).c_str());
+        p = c + 1;
+        NodeInfo n{};
+        n.id = id;
+        n.role = (id >= 1 && id <= num_servers) ? ROLE_SERVER
+                                                : ROLE_WORKER;
+        n.tenant = static_cast<uint16_t>(tenants.count(id)
+                                             ? tenants[id] : 0);
+        snprintf(n.host, sizeof(n.host), "127.0.0.1");
+        n.port = 9000 + id;
+        tmpl.push_back(n);
+      }
+    } else if (op == "tenant") {
+      const size_t eq = val.find('=');
+      if (eq == std::string::npos) return -1;
+      const int id = atoi(val.substr(0, eq).c_str());
+      const int t = atoi(val.substr(eq + 1).c_str());
+      tenants[id] = t;
+      for (auto& n : tmpl) {
+        if (n.id == id) n.tenant = static_cast<uint16_t>(t);
+      }
+    } else if (op == "report") {
+      const size_t at = val.find('@');
+      if (at == std::string::npos) return -1;
+      const int id = atoi(val.substr(0, at).c_str());
+      std::string rest = val.substr(at + 1);
+      int64_t epoch = atoll(rest.c_str());
+      int64_t hint = 0, rounds = 0;
+      size_t c1 = rest.find(',');
+      if (c1 != std::string::npos) {
+        hint = atoll(rest.substr(c1 + 1).c_str());
+        size_t c2 = rest.find(',', c1 + 1);
+        if (c2 != std::string::npos) {
+          rounds = atoll(rest.substr(c2 + 1).c_str());
+        }
+      }
+      SchedRecovery::Report r;
+      r.epoch = epoch;
+      r.rank_hint = hint;
+      r.rounds = rounds;
+      r.book = make_book();
+      r.self.id = id;
+      for (const auto& n : r.book) {
+        if (n.id == id) r.self = n;
+      }
+      rec.Ingest(id, std::move(r));
+    } else if (op == "window") {
+      long long a = 0, b = 0, w = 0;
+      if (sscanf(val.c_str(), "%lld,%lld,%lld", &a, &b, &w) != 3) {
+        return -1;
+      }
+      expired = SchedRecovery::Expired(b, a, w);
+    } else if (op == "seed") {
+      long long c = 0, t = 0;
+      if (sscanf(val.c_str(), "%lld,%lld", &c, &t) != 2) return -1;
+      seed_commit = c;
+      seed_timeout = t;
+    } else {
+      return -1;
+    }
+  }
+  std::string out = "{";
+  out += "\"reregistered\":" + std::to_string(rec.Reregistered());
+  out += ",\"expected\":[";
+  {
+    bool first = true;
+    for (int id : rec.ExpectedIds()) {
+      if (!first) out += ",";
+      first = false;
+      out += std::to_string(id);
+    }
+  }
+  out += "],\"quorum\":";
+  out += rec.QuorumMet() ? "true" : "false";
+  out += ",\"conflict\":";
+  out += rec.Conflict() ? "true" : "false";
+  out += ",\"epoch\":" + std::to_string(rec.AdoptedEpoch());
+  out += ",\"next_worker\":" +
+         std::to_string(rec.NextWorkerId(num_servers));
+  out += ",\"rosters\":{";
+  {
+    bool tfirst = true;
+    for (const auto& kv : rec.TenantRosters()) {
+      if (!tfirst) out += ",";
+      tfirst = false;
+      out += "\"" + std::to_string(kv.first) + "\":[";
+      bool first = true;
+      for (int id : kv.second) {
+        if (!first) out += ",";
+        first = false;
+        out += std::to_string(id);
+      }
+      out += "]";
+    }
+  }
+  out += "},\"rounds\":" + std::to_string(rec.RoundsWatermark());
+  out += ",\"book\":[";
+  {
+    bool first = true;
+    for (const auto& n : rec.RebuiltBook()) {
+      if (!first) out += ",";
+      first = false;
+      out += std::to_string(n.id);
+    }
+  }
+  out += "],\"expired\":";
+  out += expired ? "true" : "false";
+  {
+    const auto seeds = rec.SeedHeartbeats(seed_commit < 0 ? 0
+                                                          : seed_commit);
+    int64_t seed_min = 0;
+    for (const auto& kv : seeds) {
+      if (seed_min == 0 || kv.second < seed_min) seed_min = kv.second;
+    }
+    out += ",\"seeds\":" + std::to_string(seeds.size());
+    out += ",\"seed_min\":" + std::to_string(seed_min);
+    out += ",\"earliest_death\":" +
+           std::to_string(seed_commit < 0
+                              ? 0
+                              : SchedRecovery::EarliestDeathMs(
+                                    seed_commit, seed_timeout));
+  }
+  out += "}";
   const long long need = static_cast<long long>(out.size());
   if (buf && maxlen > 0) {
     long long n = need < maxlen - 1 ? need : maxlen - 1;

@@ -151,6 +151,29 @@ enum Command : int32_t {
                              // so the fleet timeline merge
                              // (monitor.timeline) can align per-rank
                              // clocks without NTP assumptions.
+  // Scheduler fail-over (ISSUE 15): a crashed-and-restarted scheduler
+  // rebuilds its entire state — address book, membership epoch, rank
+  // allocator high-water mark, tenant rosters, heartbeat table — from
+  // the surviving fleet's re-registrations. Control-plane by contract
+  // (only BYTEPS_CHAOS_CTRL=1 may inject faults into them, and then
+  // the park/re-dial machinery is the recovery path under test).
+  CMD_REREGISTER = 32,       // parked node -> restarted scheduler: a
+                             // state-carrying re-registration (sender =
+                             // my committed node id, arg0 = my membership
+                             // epoch, arg1 = the highest WORKER id in my
+                             // committed book (rank-allocator high-water
+                             // hint), key = my rounds-completed
+                             // watermark; payload = my own NodeInfo
+                             // followed by my full last-committed
+                             // address book). The scheduler commits once
+                             // a quorum — every non-scheduler id named
+                             // by the highest-epoch book — has reported.
+  CMD_SCHED_RESUME = 33,     // restarted scheduler -> re-registered
+                             // node: recovery committed (arg0 = adopted
+                             // epoch, arg1 = reregistered count); sent
+                             // right after a re-issued CMD_ADDRBOOK,
+                             // exactly like an elastic commit. Unparks
+                             // the node's heartbeat loop.
 };
 
 // Transient-fault tolerance: commands eligible for chaos injection,

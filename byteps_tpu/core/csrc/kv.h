@@ -279,6 +279,13 @@ class KVWorker {
     size_t n;
     {
       std::lock_guard<std::mutex> lk(mu_);
+      // Latch the shutdown for the retry loop: a request PARKED right
+      // now (paused node mid-recovery) or issued after this hook fires
+      // has no escalation owner left — the heartbeat thread that owned
+      // the park deadline exits with the fleet — so the retry loop
+      // must fail it instead of deferring forever (ISSUE 15: the
+      // scheduler-death fail-stop found this wedge).
+      fleet_failed_ = true;
       n = pending_.size();
     }
     if (n > 0) {
@@ -426,6 +433,27 @@ class KVWorker {
                      [this] { return retry_stop_; });
         if (retry_stop_) return;
         int64_t now = NowMs();
+        // Scheduler fail-over park (ISSUE 15): with the control plane
+        // down there is nobody to coordinate a fail-stop, and a
+        // transiently wedged server cannot enter hot replacement until
+        // the scheduler is back — so exhaustion escalation DEFERS
+        // while parked (resends keep flowing; the park's own window is
+        // the escalation deadline, and its expiry restores fail-stop).
+        const bool sched_parked = po_ && po_->SchedLost();
+        if (fleet_failed_) {
+          // Fleet is down (FailAllPending latched it): every pending
+          // request — parked ones included — fails now; nobody is
+          // left to resend to or to end a park.
+          for (auto& kv : pending_) exhausted.push_back(kv.first);
+          lk.unlock();
+          if (!exhausted.empty()) {
+            FailRequests(exhausted,
+                         "fleet shutdown with the request in flight — "
+                         "a server, worker or the scheduler died (see "
+                         "scheduler log); restart the job");
+          }
+          continue;
+        }
         for (auto& kv : pending_) {
           PendingReq& pr = kv.second;
           // A paused node's requests are parked, not overdue: their
@@ -434,6 +462,10 @@ class KVWorker {
           if (paused_nodes_.count(pr.node)) continue;
           if (pr.deadline_ms <= 0 || now < pr.deadline_ms) continue;
           if (pr.attempts >= retry_max_) {
+            if (sched_parked) {
+              pr.deadline_ms = now + retry_timeout_ms_;
+              continue;
+            }
             exhausted.push_back(kv.first);
             continue;
           }
@@ -515,6 +547,7 @@ class KVWorker {
   int retry_max_ = 4;
   int64_t retry_timeout_ms_ = 1000;
   bool retry_stop_ = false;  // guarded by mu_
+  bool fleet_failed_ = false;  // guarded by mu_; latched on shutdown
   std::thread retry_thread_;
 };
 

@@ -64,6 +64,25 @@ int64_t ElasticTimeoutMs() {
   return ms;
 }
 
+// Scheduler fail-over (ISSUE 15): how long a node parks on a lost
+// scheduler connection (re-dialing with capped backoff) and how long a
+// restarted scheduler waits for the fleet's re-registration quorum,
+// before either side falls back to the original fail-stop. Default 0:
+// the PR 3 scheduler-lost contract is unchanged unless armed. Needs
+// the retry layer (the park defers KV escalation) and heartbeats (the
+// failed beat IS the detector; the rebuilt death table needs seeds).
+int64_t SchedRecoveryTimeoutMs() {
+  static const int64_t ms =
+      EnvLong("BYTEPS_SCHED_RECOVERY_TIMEOUT_MS", 0);
+  return ms;
+}
+
+bool SchedRecoveryEnabled() {
+  static const bool on = SchedRecoveryTimeoutMs() > 0 && RetryEnabled() &&
+                         EnvSeconds("PS_HEARTBEAT_INTERVAL", 5.0) > 0;
+  return on;
+}
+
 int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
                       int num_workers, int num_servers,
                       AppHandler app_handler) {
@@ -71,6 +90,13 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
   num_workers_.store(num_workers);
   num_servers_ = num_servers;
   app_handler_ = std::move(app_handler);
+  // Scheduler fail-over series (ISSUE 15) exist from zero on EVERY
+  // role: a node parks (bps_sched_lost) and recovers
+  // (bps_sched_recoveries_total) on its own; the scheduler additionally
+  // reports recovery progress (/healthz reads these gauges).
+  Metrics::Get().Counter("bps_sched_recoveries_total");
+  Metrics::Get().Gauge("bps_sched_lost");
+  Metrics::Get().Gauge("bps_sched_park_ms");
   van_ = std::make_unique<Van>(
       [this](Message&& m, int fd) { ControlHandler(std::move(m), fd); });
   van_->SetDisconnectHandler([this](int fd) {
@@ -98,6 +124,16 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
       }
     }
     if (node_id < 0) return;
+    // Scheduler fail-over (ISSUE 15): with it armed, a lost scheduler
+    // connection is NOT escalated here — the heartbeat thread owns the
+    // park (its next beat fails on the dead fd and enters
+    // ParkOnSchedulerLost), and firing peer_lost here would fail the
+    // KV layer's in-flight work the park is there to preserve.
+    if (node_id == kSchedulerId && role_ != ROLE_SCHEDULER &&
+        SchedRecoveryEnabled()) {
+      Trace::Get().Note("SCHED_CONN_LOST", 0, node_id);
+      return;
+    }
     // Transient-vs-persistent fork (SURVEY.md §5, ISSUE 3): a worker's
     // lost server connection is first treated as TRANSIENT — re-dial
     // with capped backoff and let the KV retry layer drain its resend
@@ -186,11 +222,61 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
   };
   if (role == ROLE_SCHEDULER) {
     my_id_ = kSchedulerId;
+    // Scheduler fail-over (ISSUE 15): DMLC_SCHED_RECOVER marks this
+    // incarnation as a crash-restart — the launcher respawn sets it.
+    // There is no fleet to form: every survivor re-dials this (same,
+    // launcher-pinned) port and re-registers with its committed state;
+    // the book, epoch, rank high-water mark, tenant rosters, and
+    // heartbeat table are all rebuilt from that quorum. Mode must be
+    // set BEFORE Listen: re-dialing nodes race the accept loop.
+    const char* srv = getenv("DMLC_SCHED_RECOVER");
+    if (srv && *srv && strcmp(srv, "0") != 0) {
+      BPS_CHECK(SchedRecoveryEnabled())
+          << "DMLC_SCHED_RECOVER set but scheduler fail-over is not "
+             "armed (need BYTEPS_SCHED_RECOVERY_TIMEOUT_MS > 0, "
+             "BYTEPS_RETRY_MAX > 0, PS_HEARTBEAT_INTERVAL > 0)";
+      std::lock_guard<std::mutex> lk(mu_);
+      sched_recover_mode_ = true;
+      sched_rec_start_ms_ = NowMs();
+    }
+    if (sched_recover_mode_) {
+      BPS_METRIC_GAUGE_SET("bps_sched_recovering", 1);
+      BPS_LOG(WARNING) << "scheduler: restarting in RECOVERY mode — "
+                          "rebuilding state from fleet "
+                          "re-registrations (window "
+                       << SchedRecoveryTimeoutMs() << " ms)";
+      Trace::Get().Note("SCHED_RECOVER_START",
+                        SchedRecoveryTimeoutMs());
+    }
     van_->Listen(root_port);
-    // Wait for everyone to register; ControlHandler completes the handshake.
     std::unique_lock<std::mutex> lk(mu_);
-    wait_formed(lk, "topology did not complete");
+    if (sched_recover_mode_) {
+      const int64_t window = SchedRecoveryTimeoutMs();
+      bool done = cv_.wait_for(
+          lk, std::chrono::milliseconds(window), [this] {
+            return addrbook_ready_ || !sched_rec_fail_.empty() ||
+                   shutting_down_.load();
+          });
+      if (!sched_rec_fail_.empty()) {
+        BPS_CHECK(false) << "scheduler recovery failed: "
+                         << sched_rec_fail_;
+      }
+      BPS_CHECK(done && addrbook_ready_)
+          << "scheduler recovery did not reach quorum within "
+             "BYTEPS_SCHED_RECOVERY_TIMEOUT_MS=" << window << " ms ("
+          << sched_rec_.Reregistered() << " re-registered, "
+          << sched_rec_.ExpectedIds().size()
+          << " expected) — clean fail-stop";
+    } else {
+      // Wait for everyone to register; ControlHandler completes the
+      // handshake.
+      wait_formed(lk, "topology did not complete");
+    }
   } else {
+    // The endpoint a scheduler-lost park re-dials (ISSUE 15): the
+    // respawned scheduler binds the SAME root port.
+    sched_host_ = root_uri;
+    sched_port_ = root_port;
     // Deployment port mapping (the DMLC_NODE_HOST analogue for ports):
     // BYTEPS_LISTEN_PORT pins the local bind (containers with published
     // ports), BYTEPS_ADVERTISED_PORT is what peers are told to dial
@@ -306,6 +392,12 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
     Metrics::Get().Counter("bps_recoveries_total");
     Metrics::Get().Gauge("bps_membership_epoch");
     Metrics::Get().Gauge("bps_recovering");
+    // Scheduler fail-over progress (ISSUE 15): /healthz renders
+    // RECOVERING with reregistered/expected from these.
+    Metrics::Get().Gauge("bps_sched_recovering");
+    Metrics::Get().Gauge("bps_sched_rereg");
+    Metrics::Get().Gauge("bps_sched_rereg_expected");
+    Metrics::Get().Gauge("bps_sched_recovery_ms");
     // Elastic worker membership (ISSUE 8): fleet-size series live on
     // the scheduler from zero — monitor.top's fleet header and the
     // elastic tests read them.
@@ -681,6 +773,30 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
           peer_lost_cb_(node);
         }
       }
+      break;
+    }
+    case CMD_REREGISTER: {
+      HandleReregister(std::move(msg), fd);
+      break;
+    }
+    case CMD_SCHED_RESUME: {
+      // The restarted scheduler committed its recovery: adopt the
+      // epoch and release the park (ParkOnSchedulerLost is waiting on
+      // sched_resumed_; the re-issued ADDRBOOK preceded this on the
+      // same connection, so nodes_ is already the rebuilt book).
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        epoch_.store(msg.head.arg0);
+        sched_resumed_ = true;
+      }
+      BPS_METRIC_GAUGE_SET("bps_membership_epoch", epoch_.load());
+      BPS_LOG(WARNING) << "node " << my_id_
+                       << ": scheduler recovery committed — epoch "
+                       << msg.head.arg0 << ", " << msg.head.arg1
+                       << " node(s) re-registered";
+      Trace::Get().Note("SCHED_RESUME", msg.head.arg0,
+                        static_cast<int>(msg.head.arg1));
+      cv_.notify_all();
       break;
     }
     case CMD_JOIN_REQUEST: {
@@ -1075,6 +1191,284 @@ void Postoffice::HandleRecoverRegister(int fd, const NodeInfo& info,
   Trace::Get().FlightDumpAuto("epoch_resume");
 }
 
+// --- scheduler fail-over (ISSUE 15) -----------------------------------------
+
+bool Postoffice::ParkOnSchedulerLost() {
+  const int64_t window = SchedRecoveryTimeoutMs();
+  const int64_t start = NowMs();
+  sched_lost_.store(true);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    sched_resumed_ = false;
+  }
+  BPS_METRIC_GAUGE_SET("bps_sched_lost", 1);
+  BPS_LOG(WARNING) << "node " << my_id_
+                   << ": scheduler connection lost — parking "
+                      "(fail-over armed, window " << window
+                   << " ms); data plane keeps draining against the "
+                      "last committed address book";
+  Trace::Get().Note("SCHED_LOST_PARK", window);
+  // Park dump: the pre-crash control-plane trail is exactly what a
+  // post-mortem needs if the recovery then fails too.
+  Trace::Get().FlightDumpAuto("scheduler_lost");
+  long backoff_ms = EnvLong("BYTEPS_RECONNECT_BACKOFF_MS", 100);
+  if (backoff_ms < 1) backoff_ms = 1;
+  int attempt = 0;
+  while (!shutting_down_.load() && !van_->stopped() &&
+         !SchedRecovery::Expired(NowMs(), start, window)) {
+    if (attempt > 0) {
+      // The PR 3 capped backoff ladder: a restarting scheduler gets
+      // breathing room, and past the cap we probe every 2 s until the
+      // window expires.
+      long wait = backoff_ms << std::min(attempt - 1, 6);
+      if (wait > 2000) wait = 2000;
+      for (long slept = 0; slept < wait && !shutting_down_.load();
+           slept += 50) {
+        usleep(50 * 1000);
+      }
+    }
+    ++attempt;
+    int fd = van_->Connect(sched_host_, sched_port_, 1);
+    if (fd < 0) continue;
+    // Re-register with full committed state: own NodeInfo + the last
+    // committed address book (the scheduler rebuilds everything from
+    // the fleet's quorum of these).
+    MsgHeader h{};
+    h.cmd = CMD_REREGISTER;
+    h.tenant = TenantId();
+    h.sender = my_id_;
+    h.arg0 = epoch_.load();
+    h.key = round_watermark_fn_ ? round_watermark_fn_() : 0;
+    std::vector<NodeInfo> payload;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      NodeInfo self{};
+      self.id = my_id_;
+      self.role = role_;
+      int64_t max_worker = 0;
+      for (const auto& n : nodes_) {
+        if (n.id == my_id_) self = n;
+        if (n.role == ROLE_WORKER) {
+          max_worker = std::max<int64_t>(max_worker, n.id);
+        }
+      }
+      h.arg1 = max_worker;  // rank-allocator high-water hint
+      payload.reserve(nodes_.size() + 1);
+      payload.push_back(self);
+      payload.insert(payload.end(), nodes_.begin(), nodes_.end());
+    }
+    if (!van_->Send(fd, h, payload.data(),
+                    payload.size() * sizeof(NodeInfo))) {
+      continue;
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      node_fd_[kSchedulerId] = fd;
+    }
+    BPS_LOG(WARNING) << "node " << my_id_
+                     << ": re-registered with the scheduler (attempt "
+                     << attempt << ") — awaiting recovery commit";
+    // Wait out the REMAINING window for the commit. No re-dial once a
+    // REREGISTER was delivered: a scheduler that dies AGAIN
+    // mid-recovery is out of scope (the window expiry below is the
+    // clean fail-stop).
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait_for(lk,
+                 std::chrono::milliseconds(std::max<int64_t>(
+                     1, start + window - NowMs())),
+                 [this] {
+                   return sched_resumed_ || shutting_down_.load();
+                 });
+    if (!sched_resumed_) break;  // window expired (or shutting down)
+    lk.unlock();
+    sched_lost_.store(false);
+    BPS_METRIC_GAUGE_SET("bps_sched_lost", 0);
+    BPS_METRIC_COUNTER_ADD("bps_sched_recoveries_total", 1);
+    // This node's park->resume pause, scraped by bench --sched-recovery.
+    BPS_METRIC_GAUGE_SET("bps_sched_park_ms", NowMs() - start);
+    BPS_LOG(WARNING) << "node " << my_id_
+                     << ": scheduler recovered (epoch " << epoch_.load()
+                     << ") after " << NowMs() - start << " ms parked";
+    Trace::Get().Note("SCHED_RECOVERED", NowMs() - start);
+    // Commit dump: bookends the park dump above (ISSUE 15 satellite).
+    Trace::Get().FlightDumpAuto("sched_recovered");
+    if (sched_recovered_cb_) sched_recovered_cb_();
+    return true;
+  }
+  sched_lost_.store(false);
+  BPS_METRIC_GAUGE_SET("bps_sched_lost", 0);
+  BPS_LOG(WARNING) << "node " << my_id_
+                   << ": scheduler did not recover within "
+                   << window << " ms — escalating to the fail-stop";
+  return false;
+}
+
+void Postoffice::HandleReregister(Message&& msg, int fd) {
+  if (role_ != ROLE_SCHEDULER) {
+    BPS_LOG(WARNING) << "node " << my_id_
+                     << ": unexpected CMD_REREGISTER — ignored";
+    return;
+  }
+  const size_t n = msg.payload.size() / sizeof(NodeInfo);
+  if (n < 1 || msg.payload.size() % sizeof(NodeInfo) != 0) {
+    BPS_LOG(WARNING) << "scheduler: malformed CMD_REREGISTER from node "
+                     << msg.head.sender << " (" << msg.payload.size()
+                     << " bytes) — ignored";
+    return;
+  }
+  const int id = msg.head.sender;
+  SchedRecovery::Report r;
+  memcpy(&r.self, msg.payload.data(), sizeof(NodeInfo));
+  r.epoch = msg.head.arg0;
+  r.rank_hint = msg.head.arg1;
+  r.rounds = msg.head.key;
+  r.book.resize(n - 1);
+  if (n > 1) {
+    memcpy(r.book.data(), msg.payload.data() + sizeof(NodeInfo),
+           (n - 1) * sizeof(NodeInfo));
+  }
+  std::lock_guard<std::mutex> lk(mu_);
+  if (addrbook_ready_) {
+    // Already committed (or this scheduler never crashed and a chaos
+    // reset only broke the node's connection): answer idempotently
+    // with a direct ADDRBOOK + SCHED_RESUME so the parked node
+    // resumes against current state. Duplicate REREGISTERs across
+    // chaos resets land here too.
+    node_fd_[id] = fd;
+    if (!departed_.count(id)) last_heartbeat_ms_[id] = NowMs();
+    BPS_LOG(WARNING) << "scheduler: node " << id
+                     << " re-registered against committed state — "
+                        "direct resume (epoch " << epoch_.load() << ")";
+    MsgHeader ab{};
+    ab.cmd = CMD_ADDRBOOK;
+    ab.sender = kSchedulerId;
+    ab.arg0 = id;
+    van_->Send(fd, ab, nodes_.data(), nodes_.size() * sizeof(NodeInfo));
+    MsgHeader rs{};
+    rs.cmd = CMD_SCHED_RESUME;
+    rs.sender = kSchedulerId;
+    rs.arg0 = epoch_.load();
+    rs.arg1 = static_cast<int64_t>(nodes_.size()) - 1;
+    van_->Send(fd, rs);
+    return;
+  }
+  if (!sched_recover_mode_) {
+    BPS_LOG(WARNING) << "scheduler: CMD_REREGISTER from node " << id
+                     << " before fleet formation and not in recovery "
+                        "mode — ignored";
+    return;
+  }
+  sched_rec_.Ingest(id, std::move(r));
+  node_fd_[id] = fd;
+  const int rereg = sched_rec_.Reregistered();
+  const int expected =
+      static_cast<int>(sched_rec_.ExpectedIds().size());
+  BPS_METRIC_GAUGE_SET("bps_sched_rereg", rereg);
+  BPS_METRIC_GAUGE_SET("bps_sched_rereg_expected", expected);
+  BPS_LOG(WARNING) << "scheduler: node " << id
+                   << " re-registered (epoch " << msg.head.arg0 << ") — "
+                   << rereg << "/" << expected << " toward quorum";
+  Trace::Get().Note("SCHED_REREGISTER", msg.head.arg0, id);
+  if (sched_rec_.Conflict()) {
+    // Same-epoch books disagree: the old scheduler died mid-commit
+    // and there is no single committed state to resume from.
+    sched_rec_fail_ =
+        "conflicting same-epoch address books across "
+        "re-registrations (split-brain) — clean fail-stop";
+    cv_.notify_all();
+    return;
+  }
+  if (sched_rec_.QuorumMet()) CommitSchedRecoveryLocked();
+}
+
+void Postoffice::CommitSchedRecoveryLocked() {
+  const int64_t commit_ms = NowMs();
+  nodes_ = sched_rec_.RebuiltBook();
+  epoch_.store(sched_rec_.AdoptedEpoch());
+  // Worker ranks are never reused: the allocator restarts past every
+  // id any survivor has seen or hinted at.
+  next_worker_rank_ =
+      sched_rec_.NextWorkerId(num_servers_) - 1 - num_servers_;
+  int nw = 0;
+  std::map<int, int> by_tenant;
+  for (const auto& n : nodes_) {
+    if (n.role != ROLE_WORKER) continue;
+    ++nw;
+    RoundStats::Get().SetNodeTenant(n.id, n.tenant);
+    ++by_tenant[n.tenant];
+  }
+  if (nw > 0) num_workers_.store(nw);
+  // The bugfix satellite: a restarted scheduler's heartbeat table is
+  // EMPTY — checked raw, the first monitor tick would declare every
+  // rank dead at once. Seed every rebuilt-book id at commit time, so
+  // the earliest possible death verdict is commit + timeout.
+  for (const auto& kv : sched_rec_.SeedHeartbeats(commit_ms)) {
+    last_heartbeat_ms_[kv.first] = kv.second;
+  }
+  addrbook_ready_ = true;
+  sched_recover_mode_ = false;
+  BPS_METRIC_GAUGE_SET("bps_sched_recovering", 0);
+  BPS_METRIC_COUNTER_ADD("bps_sched_recoveries_total", 1);
+  BPS_METRIC_GAUGE_SET("bps_sched_recovery_ms",
+                       commit_ms - sched_rec_start_ms_);
+  BPS_METRIC_GAUGE_SET("bps_membership_epoch", epoch_.load());
+  BPS_METRIC_GAUGE_SET("bps_fleet_workers", num_workers_.load());
+  BPS_METRIC_GAUGE_SET("bps_fleet_tenants",
+                       static_cast<int64_t>(by_tenant.size()));
+  BPS_LOG(WARNING) << "scheduler: recovery committed in "
+                   << commit_ms - sched_rec_start_ms_ << " ms — epoch "
+                   << epoch_.load() << ", " << num_workers_.load()
+                   << " worker(s), " << num_servers_
+                   << " server(s), next worker rank "
+                   << next_worker_rank_ << ", rounds watermark "
+                   << sched_rec_.RoundsWatermark();
+  Trace::Get().Note("SCHED_RECOVERY_COMMIT", epoch_.load(),
+                    sched_rec_.Reregistered());
+  Trace::Get().FlightDumpAuto("sched_recovery_commit");
+  // Broadcast exactly like an elastic commit: a re-issued ADDRBOOK
+  // (arg0 = the receiver's own id) followed by the RESUME, in order,
+  // on each node's re-registered connection.
+  const int64_t rereg = sched_rec_.Reregistered();
+  for (const auto& n : nodes_) {
+    if (n.id == kSchedulerId) continue;
+    auto it = node_fd_.find(n.id);
+    if (it == node_fd_.end()) continue;
+    MsgHeader ab{};
+    ab.cmd = CMD_ADDRBOOK;
+    ab.sender = kSchedulerId;
+    ab.arg0 = n.id;
+    van_->Send(it->second, ab, nodes_.data(),
+               nodes_.size() * sizeof(NodeInfo));
+    MsgHeader rs{};
+    rs.cmd = CMD_SCHED_RESUME;
+    rs.sender = kSchedulerId;
+    rs.arg0 = epoch_.load();
+    rs.arg1 = rereg;
+    van_->Send(it->second, rs);
+  }
+  cv_.notify_all();
+  // Release joins that arrived mid-recovery (an elastic join queued
+  // across the outage): they enter the ordinary membership queue now
+  // that there is a committed book to join.
+  for (auto& bj : buffered_joins_) {
+    MemberOp op;
+    op.kind = 0;
+    op.fd = bj.second;
+    op.info = bj.first;
+    op.tenant = bj.first.tenant;
+    BPS_LOG(WARNING) << "scheduler: releasing worker join queued "
+                        "across the outage (" << op.info.host << ":"
+                     << op.info.port << ")";
+    member_queue_.push_back(std::move(op));
+  }
+  buffered_joins_.clear();
+  if (!member_queue_.empty() && !member_active_) {
+    MemberOp next = std::move(member_queue_.front());
+    member_queue_.pop_front();
+    StartMemberOpLocked(std::move(next));
+  }
+}
+
 // --- elastic worker membership (ISSUE 8) ------------------------------------
 
 void Postoffice::HandleJoinRequest(Message&& msg, int fd) {
@@ -1091,6 +1485,18 @@ void Postoffice::HandleJoinRequest(Message&& msg, int fd) {
   op.tenant = op.info.tenant;  // tenant-scoped gate + roster epoch
   std::lock_guard<std::mutex> lk(mu_);
   if (!addrbook_ready_) {
+    if (sched_recover_mode_ && ElasticEnabled()) {
+      // A joiner dialed into a scheduler that is itself recovering
+      // (ISSUE 15): queue the join until the recovery commits — the
+      // joiner's own formation bound (PS_TOPOLOGY_TIMEOUT) covers the
+      // wait, and the commit releases the queue in arrival order.
+      BPS_LOG(WARNING) << "scheduler: join request from "
+                       << op.info.host << ":" << op.info.port
+                       << " during scheduler recovery — queued until "
+                          "the recovery commits";
+      buffered_joins_.emplace_back(op.info, fd);
+      return;
+    }
     BPS_LOG(WARNING) << "scheduler: join request before fleet formation "
                         "— ignored (join a RUNNING fleet)";
     return;
@@ -1465,6 +1871,16 @@ void Postoffice::HeartbeatLoop() {
     RoundStats::Get().FillWire(&rs_payload);
     if (!van_->Send(fd, h, rs_payload.data(),
                     static_cast<int64_t>(rs_payload.size()))) {
+      // Scheduler fail-over (ISSUE 15): with it armed, park instead of
+      // the fail-stop below — the data plane keeps draining against
+      // the last committed book while we re-dial the scheduler
+      // endpoint and re-register. Only a park that exhausts
+      // BYTEPS_SCHED_RECOVERY_TIMEOUT_MS falls through to the
+      // original failure shutdown, so behavior strictly improves.
+      if (!shutting_down_.load() && SchedRecoveryEnabled() &&
+          ParkOnSchedulerLost()) {
+        continue;  // recovered — resume heartbeats to the new scheduler
+      }
       // The scheduler connection is gone. For a server this is the ONLY
       // exit signal once Finalize's indefinite wait has begun (the
       // SHUTDOWN broadcast can never arrive over a dead connection), and

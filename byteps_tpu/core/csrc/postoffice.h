@@ -21,6 +21,7 @@
 #include <vector>
 
 #include "common.h"
+#include "schedrec.h"
 #include "van.h"
 
 namespace bps {
@@ -114,6 +115,24 @@ class Postoffice {
                          int64_t join_bcast, int tenant)> cb) {
     fleet_resize_cb_ = std::move(cb);
   }
+
+  // Scheduler fail-over (ISSUE 15). Invoked (on the heartbeat thread)
+  // after a scheduler-lost park ended in a successful recovery — the
+  // worker layer clears any stale round gate a pre-crash FLEET_PAUSE
+  // left armed (its commit may have died with the old scheduler).
+  void SetSchedRecoveredCallback(std::function<void()> cb) {
+    sched_recovered_cb_ = std::move(cb);
+  }
+  // Provider for the rounds-completed watermark a CMD_REREGISTER
+  // carries (workers: the KV layer's max issued round; others 0).
+  void SetRoundWatermarkProvider(std::function<int64_t()> fn) {
+    round_watermark_fn_ = std::move(fn);
+  }
+  // True while this node is parked on a lost scheduler connection
+  // (fail-over armed): the KV retry layer defers its exhaustion
+  // escalation — with the control plane down there is nobody to
+  // coordinate a fail-stop, and the park owns the deadline.
+  bool SchedLost() const { return sched_lost_.load(); }
 
   // Worker: gated-round counters -> scheduler (join drain-free ack).
   void SendFleetPauseAck(int64_t max_round, int64_t max_bcast);
@@ -242,6 +261,27 @@ class Postoffice {
   // itself (CMD_REGISTER hello, as at stripe dial time). Runs on the
   // dead connection's recv thread, before its CloseConn.
   bool TryReconnect(int node_id, int stripe);
+  // Scheduler fail-over (ISSUE 15), node side: the scheduler
+  // connection died with fail-over armed. Park — keep the data plane
+  // draining against the last committed book — and re-dial the
+  // scheduler endpoint with the capped backoff ladder, sending a
+  // state-carrying CMD_REREGISTER on every fresh connection. Returns
+  // true once CMD_SCHED_RESUME committed the recovery (heartbeats
+  // resume); false when BYTEPS_SCHED_RECOVERY_TIMEOUT_MS expired (the
+  // caller escalates to the original fail-stop). Runs on the
+  // heartbeat thread.
+  bool ParkOnSchedulerLost();
+  // Scheduler fail-over, scheduler side: one node's CMD_REREGISTER.
+  // Recover mode ingests it into sched_rec_ and commits at quorum; an
+  // already-committed (or never-crashed) scheduler answers with an
+  // idempotent direct ADDRBOOK + SCHED_RESUME.
+  void HandleReregister(Message&& msg, int fd);
+  // Quorum reached: rebuild the book / epoch / rank high-water mark /
+  // tenant rosters from the fleet's reports, SEED the heartbeat table
+  // (an empty table would declare every rank dead on the first tick),
+  // broadcast re-issued ADDRBOOK + CMD_SCHED_RESUME, and release any
+  // joins queued across the outage. Caller holds mu_.
+  void CommitSchedRecoveryLocked();
 
   std::unique_ptr<Van> van_;
   AppHandler app_handler_;
@@ -331,6 +371,27 @@ class Postoffice {
   // Heartbeat-echo clock estimate (see ClockOffsetUs).
   std::atomic<int64_t> clock_offset_us_{0};
   std::atomic<int64_t> clock_rtt_us_{-1};
+
+  // --- scheduler fail-over (ISSUE 15) ---
+  // Node side: the scheduler endpoint to re-dial (captured at Start —
+  // the restarted scheduler binds the SAME root port, pinned by the
+  // launcher), the park flag, and the per-park resume latch.
+  std::string sched_host_;
+  int sched_port_ = 0;
+  std::atomic<bool> sched_lost_{false};
+  bool sched_resumed_ = false;            // guarded by mu_
+  std::function<void()> sched_recovered_cb_;
+  std::function<int64_t()> round_watermark_fn_;
+  // Scheduler side: recover mode (DMLC_SCHED_RECOVER), the fleet-state
+  // reconstruction, a failure reason that turns Start's recovery wait
+  // into the clean fail-stop (conflict / malformed quorum), and joins
+  // that arrived mid-recovery (released at commit). All but the start
+  // timestamp guarded by mu_.
+  bool sched_recover_mode_ = false;
+  SchedRecovery sched_rec_;
+  std::string sched_rec_fail_;
+  int64_t sched_rec_start_ms_ = 0;
+  std::vector<std::pair<NodeInfo, int>> buffered_joins_;  // info, fd
 };
 
 int64_t NowMs();
@@ -355,5 +416,16 @@ bool ElasticEnabled();
 // Fail-stop fallback window for a membership change that cannot commit
 // (a worker never acks the join gate): BYTEPS_ELASTIC_TIMEOUT_MS.
 int64_t ElasticTimeoutMs();
+
+// Scheduler fail-over master switch (ISSUE 15):
+// BYTEPS_SCHED_RECOVERY_TIMEOUT_MS > 0 (default 0 = off) AND the retry
+// layer on AND heartbeats on (the heartbeat send failure IS the
+// scheduler-lost detector, and the restarted scheduler's death
+// verdicts come from the re-seeded heartbeat table). With it off, a
+// lost scheduler connection keeps the fail-stop contract byte for
+// byte. The window bounds BOTH sides: a parked node's re-dial ladder
+// and the restarted scheduler's quorum wait.
+bool SchedRecoveryEnabled();
+int64_t SchedRecoveryTimeoutMs();
 
 }  // namespace bps

@@ -62,14 +62,19 @@ static void LogMsg(const char* dir, int fd, const MsgHeader& h,
 // --- chaos injection (BYTEPS_CHAOS_*) ---------------------------------------
 // Deterministic transient-fault injection on the send path, for the
 // fault-tolerance test harness (docs/troubleshooting.md "failure
-// model"). Applies ONLY to data-plane frames (IsDataPlaneCmd): dropping
-// control traffic would fake node deaths instead of exercising the
-// in-band retry/reconnect machinery. Zero overhead when off: one branch
-// on a cached flag per send. All faults are injected under the per-fd
-// send lock from a seeded per-connection PRNG, so a fixed seed gives a
-// reproducible fault pattern per connection.
+// model"). Applies ONLY to data-plane frames (IsDataPlaneCmd) by
+// default: dropping control traffic would fake node deaths instead of
+// exercising the in-band retry/reconnect machinery. BYTEPS_CHAOS_CTRL=1
+// (ISSUE 15) opts control-plane frames in too — there "faking" a
+// scheduler-link loss is the point, and the park/re-register fail-over
+// machinery is the recovery path under test (config.py refuses the
+// knob unless scheduler recovery is armed). Zero overhead when off: one
+// branch on a cached flag per send. All faults are injected under the
+// per-fd send lock from a seeded per-connection PRNG, so a fixed seed
+// gives a reproducible fault pattern per connection.
 struct ChaosCfg {
   bool on = false;
+  bool ctrl = false;       // also inject into control-plane frames
   uint64_t seed = 0;
   double drop = 0.0;       // P(frame silently not written)
   double dup = 0.0;        // P(frame written twice back-to-back)
@@ -93,6 +98,7 @@ static const ChaosCfg& Chaos() {
     c.delay_us = envll("BYTEPS_CHAOS_DELAY_US");
     c.reset_every = envll("BYTEPS_CHAOS_RESET_EVERY");
     c.seed = static_cast<uint64_t>(envll("BYTEPS_CHAOS_SEED"));
+    c.ctrl = envll("BYTEPS_CHAOS_CTRL") != 0;
     c.on = c.drop > 0 || c.dup > 0 || c.delay_us > 0 || c.reset_every > 0;
     return c;
   }();
@@ -457,9 +463,10 @@ bool Van::SendV(int fd, const MsgHeader& head, const struct iovec* segs,
   // send lock (so seq order == wire order). A chaos-duplicated frame
   // carries the SAME seq — it is the same frame delivered twice.
   if (tx) h.seq = ++tx->seq;
-  // Chaos injection point (data-plane frames only; see Chaos()).
+  // Chaos injection point (data-plane frames, plus control-plane with
+  // BYTEPS_CHAOS_CTRL=1; see Chaos()).
   int sends = 1;
-  if (tx && Chaos().on && IsDataPlaneCmd(h.cmd)) {
+  if (tx && Chaos().on && (IsDataPlaneCmd(h.cmd) || Chaos().ctrl)) {
     const ChaosCfg& c = Chaos();
     ++tx->data_frames;
     if (c.reset_every > 0 && tx->data_frames % c.reset_every == 0) {

@@ -226,6 +226,28 @@ void BytePSWorker::OnFleetResume(int kind, int64_t join_round,
   cv_.notify_all();
 }
 
+int64_t BytePSWorker::MaxIssuedRound() {
+  std::lock_guard<std::mutex> lk(mu_);
+  int64_t rmax = sync_round_;
+  for (auto& ctx : tensors_) rmax = std::max(rmax, ctx->round);
+  return rmax;
+}
+
+void BytePSWorker::OnSchedRecovered() {
+  bool was_gated;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    was_gated = fleet_paused_;
+    fleet_paused_ = false;
+  }
+  if (was_gated) {
+    BPS_LOG(WARNING) << "worker: lifting a stale fleet-pause gate — "
+                        "its membership change died with the old "
+                        "scheduler (re-request the join)";
+  }
+  cv_.notify_all();
+}
+
 void BytePSWorker::SyncRounds(int64_t round, int64_t bcast_round) {
   std::lock_guard<std::mutex> lk(mu_);
   // Monotone: a later join's RESUME may already have advanced the

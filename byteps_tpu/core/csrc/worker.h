@@ -238,6 +238,16 @@ class BytePSWorker {
   // scheduler's direct ADDRBOOK); applies to future Declares too.
   void SyncRounds(int64_t round, int64_t bcast_round);
 
+  // Scheduler fail-over (ISSUE 15). MaxIssuedRound: the
+  // rounds-completed watermark a CMD_REREGISTER carries (max round any
+  // tensor has issued — same arithmetic as OnFleetPause's gated-counter
+  // ack). OnSchedRecovered: a scheduler recovery committed — any round
+  // gate a pre-crash FLEET_PAUSE armed is stale (its commit died with
+  // the old scheduler; the rebuilt one has no such op in flight), so
+  // lift it rather than deadlock the next round.
+  int64_t MaxIssuedRound();
+  void OnSchedRecovered();
+
   // Hot server replacement (ISSUE 4): the postoffice's peer-recovered
   // callback lands here (van recv thread). Spawns a background thread
   // that re-declares the dead rank's key shard on the replacement,

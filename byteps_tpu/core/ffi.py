@@ -138,6 +138,12 @@ def _load() -> ctypes.CDLL:
                                           ctypes.c_longlong, ctypes.c_int,
                                           ctypes.c_void_p]
     lib.bps_wire_header_probe.restype = ctypes.c_int
+    # Scheduler fail-over (ISSUE 15): the no-fleet state-reconstruction
+    # probe (quorum / epoch adoption / rank high-water / roster rebuild
+    # / heartbeat seeding / window expiry).
+    lib.bps_sched_probe.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                    ctypes.c_longlong]
+    lib.bps_sched_probe.restype = ctypes.c_longlong
     _lib = lib
     return lib
 
@@ -212,6 +218,27 @@ def elastic_probe(script: str) -> dict:
         need = int(lib.bps_elastic_probe(script.encode(), buf, size))
         if need < 0:
             raise ValueError(f"malformed elastic probe script {script!r}")
+        if need < size:
+            return json.loads(buf.value.decode())
+        size = need + 1
+
+
+def sched_probe(script: str) -> dict:
+    """Drive the C core's standalone scheduler fail-over reconstruction
+    arithmetic (ISSUE 15) through a `;`-separated op script (servers:/
+    book:/tenant:/report:/window:/seed:) and return the rebuilt state —
+    quorum, adopted epoch, conflict verdict, rank high-water mark,
+    tenant rosters, heartbeat seeds. The no-fleet unit-test surface for
+    crash-restart recovery. Raises ValueError on a malformed script."""
+    import json
+
+    lib = _load()
+    size = 1 << 16
+    while True:
+        buf = ctypes.create_string_buffer(size)
+        need = int(lib.bps_sched_probe(script.encode(), buf, size))
+        if need < 0:
+            raise ValueError(f"malformed sched probe script {script!r}")
         if need < size:
             return json.loads(buf.value.decode())
         size = need + 1
@@ -402,6 +429,11 @@ def _apply_config_env(cfg: Optional[Config]) -> None:
     # projected.
     os.environ["BYTEPS_ELASTIC"] = "1" if cfg.elastic else "0"
     os.environ["BYTEPS_ELASTIC_TIMEOUT_MS"] = str(cfg.elastic_timeout_ms)
+    # Scheduler fail-over (ISSUE 15). DMLC_SCHED_RECOVER is per-process
+    # identity (the restarted scheduler's marker, set by the launcher
+    # respawn) and is NOT projected.
+    os.environ["BYTEPS_SCHED_RECOVERY_TIMEOUT_MS"] = str(
+        cfg.effective_sched_recovery_timeout_ms)
     # Multi-tenant PS (ISSUE 9): projected only when the job opted in —
     # leaving BYTEPS_TENANT_ID unset is the contract that keeps the
     # wire format and engine dispatch byte-for-byte the single-tenant
@@ -422,6 +454,7 @@ def _apply_config_env(cfg: Optional[Config]) -> None:
     os.environ["BYTEPS_CHAOS_DUP"] = str(cfg.chaos_dup)
     os.environ["BYTEPS_CHAOS_DELAY_US"] = str(cfg.chaos_delay_us)
     os.environ["BYTEPS_CHAOS_RESET_EVERY"] = str(cfg.chaos_reset_every)
+    os.environ["BYTEPS_CHAOS_CTRL"] = "1" if cfg.chaos_ctrl else "0"
 
 
 class _Node:

@@ -105,10 +105,10 @@ def _reap(procs: List[subprocess.Popen], names: Optional[List[str]] = None,
     checkpoint-on-term training script) cannot wedge the launcher.
 
     --supervise mode: ``respawn(name)`` (when given) returns a fresh
-    Popen for a dead SERVER role — hot replacement via
-    DMLC_RECOVER_RANK — and up to ``supervise`` such respawns replace
-    the fail-fast for server children. Scheduler deaths, and server
-    deaths past the budget, fail fast as before.
+    Popen for a dead SERVER or SCHEDULER role — hot replacement via
+    DMLC_RECOVER_RANK, crash-restart via DMLC_SCHED_RECOVER — and up
+    to ``supervise`` such respawns replace the fail-fast for those
+    children. Deaths past the budget fail fast as before.
 
     --elastic mode hooks (ISSUE 8): ``poll_hook(remaining)`` runs every
     loop tick and returns newly spawned children to track (the SIGHUP
@@ -150,12 +150,16 @@ def _reap(procs: List[subprocess.Popen], names: Optional[List[str]] = None,
                           f"{_describe_exit(code)}", file=sys.stderr,
                           flush=True)
                     if (respawn is not None and term_deadline is None
-                            and name.startswith("server") and budget > 0):
+                            and (name.startswith("server")
+                                 or name == "scheduler") and budget > 0):
                         budget -= 1
                         fresh = respawn(name)
                         if fresh is not None:
-                            print(f"bpslaunch: respawning {name} as hot "
-                                  f"replacement (pid {fresh.pid}, "
+                            kind = ("crash-restart"
+                                    if name == "scheduler"
+                                    else "hot replacement")
+                            print(f"bpslaunch: respawning {name} as "
+                                  f"{kind} (pid {fresh.pid}, "
                                   f"{budget} respawn(s) left)",
                                   file=sys.stderr, flush=True)
                             procs.append(fresh)
@@ -312,10 +316,32 @@ def launch_local_fleet(command: Sequence[str], num_workers: int,
         print(f"bpslaunch: spawned {name} pid={p.pid}", file=sys.stderr,
               flush=True)
 
+    sched_respawns = {"count": 0}
+
     def _respawn_server(name: str) -> Optional[subprocess.Popen]:
-        # Hot replacement: respawn ONLY the dead server role, marked
-        # with DMLC_RECOVER_RANK so it adopts the dead rank's id and
-        # key shard instead of joining fleet formation.
+        # Hot replacement: respawn ONLY the dead control-plane role.
+        # A server comes back with DMLC_RECOVER_RANK so it adopts the
+        # dead rank's id and key shard instead of joining formation; a
+        # scheduler comes back with DMLC_SCHED_RECOVER so it rebuilds
+        # its address book / rank allocator / tenant rosters from the
+        # fleet's re-registrations (the port is pinned in base, so
+        # parked nodes re-dial the same endpoint).
+        if name == "scheduler":
+            if (base.get("BYTEPS_SCHED_RECOVERY_TIMEOUT_MS", "0")
+                    or "0").strip() in ("", "0"):
+                print("bpslaunch: scheduler died but "
+                      "BYTEPS_SCHED_RECOVERY_TIMEOUT_MS is unset/0 — "
+                      "the fleet cannot re-register; failing fast",
+                      file=sys.stderr, flush=True)
+                return None
+            # Capped backoff between scheduler respawns: the pinned
+            # port may still be in TIME_WAIT, and a crash-looping
+            # scheduler must not burn the whole budget in a second.
+            delay = min(0.2 * (2 ** sched_respawns["count"]), 5.0)
+            sched_respawns["count"] += 1
+            time.sleep(delay)
+            e = _role_env(base, "scheduler", DMLC_SCHED_RECOVER="1")
+            return subprocess.Popen(server_cmd, env=e)
         rank = int(name[len("server"):])
         e = _role_env(base, "server", DMLC_RECOVER_RANK=str(rank))
         return subprocess.Popen(server_cmd, env=e)
@@ -461,8 +487,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "of failing the whole fleet; the scheduler "
                         "coordinates the epoch pause + shard re-seed "
                         "(requires BYTEPS_RECOVERY_TIMEOUT_MS > 0, the "
-                        "default). Scheduler/worker deaths still fail "
-                        "fast (pair with --restarts for those)")
+                        "default). A dead SCHEDULER is respawned too "
+                        "when BYTEPS_SCHED_RECOVERY_TIMEOUT_MS > 0: the "
+                        "restart carries DMLC_SCHED_RECOVER=1 and "
+                        "rebuilds control-plane state from the parked "
+                        "fleet's re-registrations. Worker deaths still "
+                        "fail fast (pair with --elastic or --restarts "
+                        "for those)")
     p.add_argument("--restarts", type=int, default=0,
                    help="--local mode: relaunch the whole fleet up to N "
                         "times after a failed run (elastic-ish recovery: "
@@ -477,11 +508,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--chaos", metavar="SPEC", default="",
                    help="arm the deterministic fault-injection layer for "
                         "the whole fleet: comma-separated knobs "
-                        "drop=P,dup=P,delay-us=N,reset-every=N,seed=N "
-                        "(sets BYTEPS_CHAOS_*; e.g. --chaos "
-                        "drop=0.01,reset-every=1000,seed=42). Requires "
-                        "the retry layer (BYTEPS_RETRY_MAX > 0, the "
-                        "default); see docs/troubleshooting.md")
+                        "drop=P,dup=P,delay-us=N,reset-every=N,seed=N,"
+                        "ctrl=1 (sets BYTEPS_CHAOS_*; e.g. --chaos "
+                        "drop=0.01,reset-every=1000,seed=42). ctrl=1 "
+                        "extends injection to CONTROL-plane frames and "
+                        "requires scheduler fail-over armed "
+                        "(BYTEPS_SCHED_RECOVERY_TIMEOUT_MS > 0). "
+                        "Requires the retry layer (BYTEPS_RETRY_MAX > "
+                        "0, the default); see docs/troubleshooting.md")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="worker command, e.g. python train.py")
     args = p.parse_args(argv)
@@ -520,7 +554,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                       "dup": "BYTEPS_CHAOS_DUP",
                       "delay-us": "BYTEPS_CHAOS_DELAY_US",
                       "reset-every": "BYTEPS_CHAOS_RESET_EVERY",
-                      "seed": "BYTEPS_CHAOS_SEED"}
+                      "seed": "BYTEPS_CHAOS_SEED",
+                      "ctrl": "BYTEPS_CHAOS_CTRL"}
         for item in args.chaos.split(","):
             key, sep, val = item.partition("=")
             key = key.strip().lower()

@@ -76,7 +76,14 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 node = snap.get("node", {})
                 counters = snap.get("counters", {})
                 gauges = snap.get("gauges", {})
-                recovering = bool(gauges.get("bps_recovering", 0))
+                # RECOVERING covers both flavours: a server rank being
+                # hot-replaced (bps_recovering), a restarted scheduler
+                # collecting its re-registration quorum
+                # (bps_sched_recovering), and a node parked on a lost
+                # scheduler (bps_sched_lost).
+                recovering = bool(gauges.get("bps_recovering", 0)
+                                  or gauges.get("bps_sched_recovering", 0)
+                                  or gauges.get("bps_sched_lost", 0))
                 healthy = bool(node.get("inited")) and not dead
                 # Fleet state: RECOVERING while a server rank is being
                 # hot-replaced (healthy-but-paused, NOT degraded — the
@@ -112,6 +119,20 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                         counters.get("bps_worker_joins_total", 0)),
                     "leaves": int(
                         counters.get("bps_worker_leaves_total", 0)),
+                    # Scheduler fail-over (ISSUE 15): parked flag on
+                    # every role; the restarted scheduler additionally
+                    # reports its re-registration progress so an
+                    # operator can see `reregistered/expected` converge
+                    # toward quorum during the outage.
+                    "sched_lost": bool(gauges.get("bps_sched_lost", 0)),
+                    "sched_recovering": bool(
+                        gauges.get("bps_sched_recovering", 0)),
+                    "sched_recoveries": int(
+                        counters.get("bps_sched_recoveries_total", 0)),
+                    "reregistered": int(
+                        gauges.get("bps_sched_rereg", 0)),
+                    "expected": int(
+                        gauges.get("bps_sched_rereg_expected", 0)),
                     "uptime_s": round(
                         time.monotonic() - self.server.started_at, 3),
                 }).encode()

@@ -156,6 +156,12 @@ def analyze(scrapes: Dict[str, Optional[dict]],
             # re-seeded, and whether one is in progress right now.
             "recoveries": int(_sample(m, "bps_recoveries_total")),
             "recovering": bool(_sample(m, "bps_recovering")),
+            # Scheduler fail-over (ISSUE 15): 1 while this worker is
+            # PARKED on a lost scheduler (data plane still draining,
+            # control plane frozen, re-dialling the endpoint).
+            "sched_lost": bool(_sample(m, "bps_sched_lost")),
+            "sched_recoveries": int(
+                _sample(m, "bps_sched_recoveries_total")),
             # Trace health (ISSUE 5): drop-oldest overwrites in the main
             # trace ring mean the timeline is missing events — raise
             # BYTEPS_TRACE_RING_EVENTS or narrow the step window.
@@ -229,6 +235,12 @@ def analyze(scrapes: Dict[str, Optional[dict]],
     fleet_workers = 0
     resizing = False
     joins = leaves = 0
+    # Scheduler fail-over (ISSUE 15): the fleet counts as
+    # SCHED-RECOVERING when any node is parked on a lost scheduler OR
+    # a restarted scheduler is still collecting its quorum.
+    sched_recovering = any(w.get("sched_lost") for w in workers.values())
+    sched_recoveries = 0
+    sched_rereg = sched_rereg_expected = 0
     sched = scrapes.get("scheduler")
     if sched:
         for labels in sched.get("bps_node_dead", {}):
@@ -249,6 +261,13 @@ def analyze(scrapes: Dict[str, Optional[dict]],
         resizing = bool(_sample(sched, "bps_fleet_resizing"))
         joins = int(_sample(sched, "bps_worker_joins_total"))
         leaves = int(_sample(sched, "bps_worker_leaves_total"))
+        sched_recovering = sched_recovering or bool(
+            _sample(sched, "bps_sched_recovering"))
+        sched_recoveries = int(
+            _sample(sched, "bps_sched_recoveries_total"))
+        sched_rereg = int(_sample(sched, "bps_sched_rereg"))
+        sched_rereg_expected = int(
+            _sample(sched, "bps_sched_rereg_expected"))
 
     # Fleet state (ISSUE 7): classify the workers' last-round records
     # with the same rules the /rounds watcher applies.
@@ -295,6 +314,11 @@ def analyze(scrapes: Dict[str, Optional[dict]],
         "resizing": resizing,
         "joins": joins,
         "leaves": leaves,
+        # Scheduler fail-over (ISSUE 15; docs/troubleshooting.md).
+        "sched_recovering": sched_recovering,
+        "sched_recoveries": sched_recoveries,
+        "sched_reregistered": sched_rereg,
+        "sched_expected": sched_rereg_expected,
         # Per-round insight (docs/monitoring.md "Round insight").
         "fleet_state": fleet_state,
         "fleet_bottleneck": fleet_bottleneck,
@@ -316,6 +340,12 @@ def _print_report(report: dict, as_json: bool) -> None:
         print(f"fleet: {report['fleet_workers']} worker(s)"
               + (" — RESIZING (membership change committing)"
                  if report.get("resizing") else "") + extra)
+    if report.get("sched_recovering"):
+        print(f"fleet: SCHED-RECOVERING (scheduler lost/restarting; "
+              f"{report.get('sched_reregistered', 0)}/"
+              f"{report.get('sched_expected', 0)} node(s) "
+              "re-registered; data plane draining against the last "
+              "committed address book)")
     if report.get("recovering"):
         print(f"fleet: RECOVERING (membership epoch {report['epoch']}; "
               "a server rank is being hot-replaced)")

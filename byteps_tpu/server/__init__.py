@@ -23,6 +23,18 @@ def main() -> None:
         # and broadcasts the epoch RESUME, and the workers re-seed us.
         print(f"byteps_tpu.server: starting as hot replacement for "
               f"server rank {recover_rank}", file=sys.stderr, flush=True)
+    sched_recover = os.environ.get("DMLC_SCHED_RECOVER", "")
+    if sched_recover and role == "scheduler":
+        # Scheduler fail-over (ISSUE 15): this incarnation is a
+        # crash-restart of the control plane. Start() listens on the
+        # same pinned port and rebuilds the address book / rank
+        # allocator / tenant rosters from the parked fleet's
+        # CMD_REREGISTER quorum instead of running fleet formation; a
+        # failed rebuild (conflict / window expiry) aborts nonzero so
+        # the supervisor can attribute the death.
+        print("byteps_tpu.server: starting as scheduler crash-restart "
+              "(DMLC_SCHED_RECOVER) — waiting for the fleet's "
+              "re-registration quorum", file=sys.stderr, flush=True)
     from byteps_tpu.core import Scheduler, Server
     if role == "scheduler":
         node = Scheduler.start()

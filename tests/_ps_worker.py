@@ -953,6 +953,8 @@ def main() -> int:
                     "bps_reconnects_total", 0),
                 "chaos_injected": snap["counters"].get(
                     "bps_chaos_injected_total", 0),
+                "sched_recoveries": snap["counters"].get(
+                    "bps_sched_recoveries_total", 0),
             }), flush=True)
             w.barrier(GROUP_WORKERS)
 

@@ -1,0 +1,458 @@
+"""Scheduler fail-over tests (ISSUE 15): crash-restart control plane
+with fleet-sourced state reconstruction.
+
+Two tiers in one file:
+
+- FAST (tier-1, no fleet): the re-registration quorum / epoch adoption /
+  rank high-water / tenant-roster / heartbeat-seeding bookkeeping driven
+  through the ``bps_sched_probe`` FFI hook, plus the config validation
+  for the new knobs.
+- PS tier (``pytest -m schedrec``): the acceptance runs — SIGKILL the
+  scheduler mid-training on a 2w x 2s fleet and crash-restart it with
+  DMLC_SCHED_RECOVER (bit-identical digest, exactly one scheduler
+  recovery per node), the same run under seeded data-plane chaos, the
+  recovery-off fail-stop contract, the launcher's ``--supervise``
+  scheduler respawn, and an elastic join riding across the outage.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tests.ps_utils import free_port, spawn_role, spawn_worker, topology_env
+from tests.test_recovery import _clean_digest, _wait_for_round
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_ps_worker.py")
+ELASTIC_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "_elastic_member_worker.py")
+
+# Tight clocks so a full kill -> park -> respawn -> re-register -> resume
+# cycle fits in seconds. The fail-over window must exceed
+# PS_HEARTBEAT_TIMEOUT (config validation) — every node needs at least
+# one failed beat just to notice the crash.
+SCHED_ENV = {
+    "PS_HEARTBEAT_INTERVAL": "0.5",
+    "PS_HEARTBEAT_TIMEOUT": "2",
+    "BYTEPS_SCHED_RECOVERY_TIMEOUT_MS": "30000",
+    "BYTEPS_RECOVERY_TIMEOUT_MS": "20000",
+    "BYTEPS_RETRY_TIMEOUT_MS": "300",
+    "BYTEPS_RECONNECT_BACKOFF_MS": "50",
+    "BYTEPS_LOG_LEVEL": "INFO",
+}
+
+
+# --- fast tier: reconstruction bookkeeping (no fleet) -----------------------
+
+def _probe(script):
+    from byteps_tpu.core.ffi import sched_probe
+    return sched_probe(script)
+
+
+@pytest.mark.schedrec
+def test_probe_quorum_requires_every_expected_node():
+    # 2 servers (ids 1, 2) + 2 workers (ids 3, 4); quorum only once all
+    # four non-scheduler ids of the committed book have re-registered.
+    base = "servers:2;book:1,2,3,4;"
+    r = _probe(base + "report:1@0;report:3@0")
+    assert r["reregistered"] == 2
+    assert r["expected"] == [1, 2, 3, 4]
+    assert r["quorum"] is False
+    r = _probe(base + "report:1@0;report:2@0;report:3@0;report:4@0")
+    assert r["quorum"] is True
+    assert r["conflict"] is False
+    # The rebuilt book is the committed one, scheduler included.
+    assert r["book"] == [0, 1, 2, 3, 4]
+    # An empty window (nobody re-registered) is NOT a vacuous quorum.
+    r = _probe("servers:2")
+    assert r["quorum"] is False
+
+
+@pytest.mark.schedrec
+def test_probe_reregister_is_idempotent():
+    # Re-dials duplicate CMD_REREGISTER; the count must not inflate
+    # (a double-counted node would fake a quorum).
+    r = _probe("servers:2;book:1,2,3,4;report:3@0;report:3@0;report:3@0")
+    assert r["reregistered"] == 1
+    assert r["quorum"] is False
+
+
+@pytest.mark.schedrec
+def test_probe_epoch_max_adoption():
+    # A node that missed the last elastic commit reports a stale
+    # epoch/book; the scheduler adopts the HIGHEST epoch and its book
+    # defines the expected set.
+    r = _probe("servers:2;book:1,2,3;report:1@1;"
+               "book:1,2,3,4;report:4@2;report:2@2;report:3@2")
+    assert r["epoch"] == 2
+    assert r["expected"] == [1, 2, 3, 4]
+    # Quorum needs EVERY id of the epoch-2 book, and node 1 already
+    # reported (with its stale book) — so quorum is met.
+    assert r["quorum"] is True
+    assert r["book"] == [0, 1, 2, 3, 4]
+
+
+@pytest.mark.schedrec
+def test_probe_rank_allocator_high_water():
+    # Worker ids 3, 5 alive (4 departed): the next allocated worker id
+    # must clear the HIGH WATER (6), never reuse 4 — rank reuse would
+    # resurrect the departed rank's dedup state.
+    r = _probe("servers:2;book:1,2,3,5;report:3@1")
+    assert r["next_worker"] == 6
+    # Servers only: first worker id is num_servers + 1.
+    r = _probe("servers:2;book:1,2;report:1@0")
+    assert r["next_worker"] == 3
+
+
+@pytest.mark.schedrec
+def test_probe_tenant_roster_rebuild():
+    r = _probe("servers:2;book:1,2,3,4;tenant:3=7;tenant:4=9;"
+               "report:1@0;report:2@0;report:3@0;report:4@0")
+    assert r["rosters"] == {"7": [3], "9": [4]}
+    assert r["quorum"] is True
+
+
+@pytest.mark.schedrec
+def test_probe_same_epoch_conflicting_books():
+    # Two nodes claim the SAME epoch with different member sets: the
+    # committed history diverged, reconstruction must refuse (clean
+    # fail-stop), never guess.
+    r = _probe("servers:2;book:1,2,3;report:1@1;"
+               "book:1,2,3,4;report:2@1")
+    assert r["conflict"] is True
+
+
+@pytest.mark.schedrec
+def test_probe_rounds_watermark():
+    # The fleet-wide watermark is the MAX reported round: the adopted
+    # round gating must never go backwards for any node.
+    r = _probe("servers:2;book:1,2,3,4;report:3@0,0,12;report:4@0,0,7")
+    assert r["rounds"] == 12
+
+
+@pytest.mark.schedrec
+def test_probe_window_expiry():
+    assert _probe("window:1000,5000,3000")["expired"] is True
+    assert _probe("window:1000,3500,3000")["expired"] is False
+
+
+@pytest.mark.schedrec
+def test_probe_heartbeat_seed_no_early_death():
+    # The bugfix satellite: the rebuilt heartbeat table is seeded at the
+    # COMMIT timestamp, so the earliest possible death verdict is a full
+    # PS_HEARTBEAT_TIMEOUT after RESUME — no node can be declared dead
+    # within one heartbeat interval of resuming (it legitimately has not
+    # beaten the new scheduler yet).
+    commit_ms, timeout_ms, interval_ms = 10_000, 2_000, 500
+    r = _probe("servers:2;book:1,2,3,4;"
+               "report:1@0;report:2@0;report:3@0;report:4@0;"
+               f"seed:{commit_ms},{timeout_ms}")
+    assert r["seeds"] == 4
+    assert r["seed_min"] == commit_ms
+    assert r["earliest_death"] == commit_ms + timeout_ms
+    assert r["earliest_death"] - commit_ms >= interval_ms
+
+
+@pytest.mark.schedrec
+def test_probe_rejects_malformed_script():
+    with pytest.raises(ValueError):
+        _probe("servers:2;frobnicate:3")
+
+
+@pytest.mark.schedrec
+def test_config_sched_recovery_validation():
+    from byteps_tpu.config import Config
+    Config(sched_recovery_timeout_ms=60000).validate()
+    with pytest.raises(ValueError, match="BYTEPS_RETRY_MAX"):
+        Config(sched_recovery_timeout_ms=60000, retry_max=0).validate()
+    with pytest.raises(ValueError, match="PS_HEARTBEAT_INTERVAL"):
+        Config(sched_recovery_timeout_ms=60000,
+               heartbeat_interval_s=0).validate()
+    # The window must exceed the heartbeat timeout: a node needs a
+    # failed beat just to NOTICE the crash.
+    with pytest.raises(ValueError, match="PS_HEARTBEAT_TIMEOUT"):
+        Config(sched_recovery_timeout_ms=20000,
+               heartbeat_timeout_s=30.0).validate()
+    with pytest.raises(ValueError, match="DMLC_SCHED_RECOVER"):
+        Config(sched_recover=True, role="scheduler").validate()
+    with pytest.raises(ValueError, match="scheduler-process"):
+        Config(sched_recover=True, sched_recovery_timeout_ms=60000,
+               role="worker").validate()
+    # Control-plane chaos with no recovery path is just a slow
+    # fail-stop; the error must name the knob to arm.
+    with pytest.raises(ValueError,
+                       match="BYTEPS_SCHED_RECOVERY_TIMEOUT_MS"):
+        Config(chaos_ctrl=True, chaos_drop=0.01).validate()
+    Config(chaos_ctrl=True, chaos_drop=0.01,
+           sched_recovery_timeout_ms=60000).validate()
+    with pytest.warns(UserWarning, match="nothing to inject"):
+        Config(chaos_ctrl=True,
+               sched_recovery_timeout_ms=60000).validate()
+
+
+# --- ps tier: the acceptance fleets -----------------------------------------
+
+def _reap_all(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            p.communicate()
+
+
+def _kill_sched_run(extra_env, respawn_delay_s=1.0):
+    """One 2w x 2s recovery-mode run: SIGKILL the scheduler after round
+    1, crash-restart it with DMLC_SCHED_RECOVER after
+    ``respawn_delay_s``, reap the fleet. Returns (worker rows,
+    restarted scheduler's output)."""
+    port = free_port()
+    env = topology_env(2, 2, port, extra_env)
+    sched = spawn_role("scheduler", env)
+    servers = [spawn_role("server", env) for _ in range(2)]
+    workers = [spawn_worker(WORKER, env, r, "recovery") for r in range(2)]
+    replacement = None
+    procs = [sched, *servers, *workers]
+    try:
+        _wait_for_round(workers[0], 1)
+        sched.kill()  # hard death: no goodbye, port freed, state gone
+        time.sleep(respawn_delay_s)
+        renv = dict(env)
+        renv["DMLC_SCHED_RECOVER"] = "1"
+        replacement = spawn_role("scheduler", renv)
+        procs.append(replacement)
+
+        rows = []
+        for wp in workers:
+            out, _ = wp.communicate(timeout=150)
+            assert wp.returncode == 0, (
+                f"worker failed instead of riding the fail-over:\n{out}")
+            rows += [json.loads(ln) for ln in out.splitlines()
+                     if ln.startswith("{")]
+        # Clean teardown: both servers and the RESTARTED scheduler exit
+        # 0 (the goodbyes land at the new incarnation).
+        for srv in servers:
+            srv_out, _ = srv.communicate(timeout=30)
+            assert srv.returncode == 0, srv_out
+        rout, _ = replacement.communicate(timeout=30)
+        assert replacement.returncode == 0, rout
+        sched.communicate()
+        assert len(rows) == 2, rows
+        return rows, rout
+    finally:
+        _reap_all(procs)
+
+
+@pytest.mark.ps
+@pytest.mark.schedrec
+def test_kill_scheduler_crash_restart_bit_identical():
+    """The tentpole acceptance: SIGKILL the scheduler mid-round. Every
+    node parks (data plane keeps draining), the crash-restarted
+    scheduler rebuilds its address book / rank allocator / tenant
+    rosters from the fleet's re-registration quorum and broadcasts the
+    RESUME — and training completes BIT-IDENTICAL to the fault-free run
+    with exactly one scheduler recovery on every worker."""
+    rows, rout = _kill_sched_run(dict(SCHED_ENV))
+    assert all(r["sched_recoveries"] == 1 for r in rows), rows
+    assert all(r["recoveries"] == 0 for r in rows), rows  # no server died
+    # Recovery ADOPTS the committed epoch; it never bumps it (nothing
+    # about the membership changed).
+    assert all(r["epoch"] == 0 for r in rows), rows
+    assert len({r["digest"] for r in rows}) == 1, rows
+    assert rows[0]["digest"] == _clean_digest(), (
+        "fail-over run diverged from the fault-free run", rows)
+    assert "RECOVERY mode" in rout, rout
+    assert "recovery committed" in rout, rout
+
+
+@pytest.mark.ps
+@pytest.mark.schedrec
+@pytest.mark.chaos
+def test_sched_recovery_under_chaos_bit_identical():
+    """Data-plane chaos (seeded drop + dup) keeps injecting while the
+    scheduler is killed and crash-restarted: the park keeps the retry /
+    dedup machinery draining against the last committed address book,
+    so the digest must still reproduce bit for bit."""
+    extra = dict(SCHED_ENV)
+    extra.update({
+        "BYTEPS_CHAOS_SEED": "42",
+        "BYTEPS_CHAOS_DROP": "0.02",
+        "BYTEPS_CHAOS_DUP": "0.02",
+    })
+    rows, _ = _kill_sched_run(extra)
+    assert all(r["sched_recoveries"] == 1 for r in rows), rows
+    assert all(r["chaos_injected"] > 0 for r in rows), rows
+    assert len({r["digest"] for r in rows}) == 1, rows
+    assert rows[0]["digest"] == _clean_digest(), (
+        "chaos + fail-over run diverged from the fault-free run", rows)
+
+
+@pytest.mark.ps
+@pytest.mark.schedrec
+def test_sched_recovery_off_preserves_fail_stop():
+    """With BYTEPS_SCHED_RECOVERY_TIMEOUT_MS unset the PR 3 contract is
+    untouched: a dead scheduler is a fleet-wide fail-stop — workers and
+    servers exit nonzero instead of parking."""
+    port = free_port()
+    extra = dict(SCHED_ENV)
+    del extra["BYTEPS_SCHED_RECOVERY_TIMEOUT_MS"]
+    env = topology_env(2, 2, port, extra)
+    sched = spawn_role("scheduler", env)
+    servers = [spawn_role("server", env) for _ in range(2)]
+    workers = [spawn_worker(WORKER, env, r, "recovery") for r in range(2)]
+    procs = [sched, *servers, *workers]
+    try:
+        _wait_for_round(workers[0], 1)
+        sched.kill()
+        t0 = time.time()
+        out0, _ = workers[0].communicate(timeout=60)
+        detect_s = time.time() - t0
+        assert workers[0].returncode != 0, (
+            "worker must fail-stop with fail-over unarmed:\n" + out0)
+        assert detect_s < 30, f"fail-stop too slow: {detect_s}s"
+        # The SERVER-park log ("server N unreachable — parking its
+        # in-flight requests") may legitimately appear while the fleet
+        # collapses; only a SCHEDULER park would violate the contract.
+        assert "scheduler connection lost — parking" not in out0, out0
+        assert "fail-over armed" not in out0, out0
+        out1, _ = workers[1].communicate(timeout=30)
+        assert workers[1].returncode != 0, out1
+        for srv in servers:
+            srv_out, _ = srv.communicate(timeout=30)
+            assert srv.returncode != 0, srv_out
+        sched.communicate()
+    finally:
+        _reap_all(procs)
+
+
+@pytest.mark.ps
+@pytest.mark.schedrec
+def test_launcher_supervise_respawns_dead_scheduler():
+    """Launcher satellite: `bpslaunch --local --supervise N` with
+    fail-over armed respawns a SIGKILLed scheduler as a crash-restart
+    (DMLC_SCHED_RECOVER, attribution line, restart budget) and the
+    fleet completes with exit 0 and the fault-free digest."""
+    from tests.ps_utils import REPO
+
+    env = dict(os.environ)
+    env.update(SCHED_ENV)
+    env.update({
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BPS_TEST_MODE": "recovery",
+        "BPS_TEST_ROUNDS": "8",
+        "BPS_TEST_ROUND_SLEEP": "0.3",
+    })
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.launcher", "--local", "2",
+         "--num-servers", "2", "--supervise", "2", "--",
+         sys.executable, WORKER],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        sched_pid = None
+        deadline = time.time() + 120
+        consumed = []
+        for line in proc.stdout:
+            consumed.append(line)
+            m = re.match(r"bpslaunch: spawned scheduler pid=(\d+)", line)
+            if m:
+                sched_pid = int(m.group(1))
+            if line.startswith("round 1") and sched_pid is not None:
+                break
+            if time.time() > deadline:
+                break
+        assert sched_pid is not None, "".join(consumed)
+        os.kill(sched_pid, signal.SIGKILL)
+        rest, _ = proc.communicate(timeout=180)
+        out = "".join(consumed) + rest
+        assert proc.returncode == 0, out
+        assert re.search(r"scheduler \(pid \d+\) died with signal 9",
+                         out), out
+        assert "respawning scheduler as crash-restart" in out, out
+        assert out.count("respawning scheduler") == 1, out
+        # Two workers writing to one merged pipe can interleave their
+        # JSON rows onto a single physical line; decode greedily.
+        dec = json.JSONDecoder()
+        rows = []
+        for ln in out.splitlines():
+            ln = ln.strip()
+            while ln.startswith("{"):
+                try:
+                    row, end = dec.raw_decode(ln)
+                except ValueError:
+                    break
+                rows.append(row)
+                ln = ln[end:].lstrip()
+        assert len(rows) == 2, out
+        assert all(r["sched_recoveries"] == 1 for r in rows), rows
+        assert rows[0]["digest"] == _clean_digest(), rows
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+
+@pytest.mark.ps
+@pytest.mark.schedrec
+@pytest.mark.elastic
+def test_elastic_join_rides_across_the_outage():
+    """An elastic joiner dispatched WHILE the scheduler is dead must be
+    admitted once the crash-restart commits (the joiner's dial retries
+    ride the outage; a join landing mid-recovery is queued until the
+    commit) — and the growth is a normal epoch bump on the survivors."""
+    import tempfile
+
+    port = free_port()
+    stop_file = os.path.join(tempfile.mkdtemp(prefix="bps_schedrec_"),
+                             "stop")
+    extra = dict(SCHED_ENV)
+    extra.update({
+        "BYTEPS_ELASTIC": "1",
+        "BPS_TEST_STOP_FILE": stop_file,
+    })
+    env = topology_env(2, 2, port, extra)
+    sched = spawn_role("scheduler", env)
+    servers = [spawn_role("server", env) for _ in range(2)]
+    workers = [spawn_worker(ELASTIC_WORKER, env, r, "launcher_elastic")
+               for r in range(2)]
+    procs = [sched, *servers, *workers]
+    joiner = None
+    replacement = None
+    try:
+        _wait_for_round(workers[0], 2)
+        sched.kill()
+        time.sleep(0.8)  # every node notices the loss and parks
+        joiner = spawn_worker(ELASTIC_WORKER, env, 0, "launcher_elastic",
+                              extra={"DMLC_JOIN": "1"})
+        procs.append(joiner)
+        renv = dict(env)
+        renv["DMLC_SCHED_RECOVER"] = "1"
+        replacement = spawn_role("scheduler", renv)
+        procs.append(replacement)
+        # The joiner printing rounds proves it was admitted to the
+        # POST-RECOVERY fleet and is aggregating with the survivors.
+        _wait_for_round(joiner, 0, timeout_s=90)
+        with open(stop_file, "w") as f:
+            f.write("stop\n")
+        outs = []
+        for wp in (*workers, joiner):
+            out, _ = wp.communicate(timeout=120)
+            assert wp.returncode == 0, f"member failed:\n{out}"
+            outs.append(out)
+        # Survivors ended at epoch 1 (the join) with 3 live workers.
+        assert "launcher_elastic OK (epoch 1, 3 workers)" in outs[0], (
+            outs[0])
+        rout, _ = replacement.communicate(timeout=30)
+        assert replacement.returncode == 0, rout
+        assert "recovery committed" in rout, rout
+        assert "worker joined as rank 2" in rout, rout
+        for srv in servers:
+            srv_out, _ = srv.communicate(timeout=30)
+            assert srv.returncode == 0, srv_out
+        sched.communicate()
+    finally:
+        _reap_all(procs)

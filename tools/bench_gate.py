@@ -64,6 +64,7 @@ KNOWN_FAMILIES = frozenset({
     "priority",
     "ps",
     "scaling",
+    "sched",        # ISSUE 15: scheduler fail-over park→resume bench
     "shm_van",
     "striping",
     "tenant",       # ISSUE 9: multi-tenant weighted-split bench
