@@ -38,7 +38,7 @@ def _env_str(name: str, default: str) -> str:
     return v if v not in (None, "") else default
 
 
-VALID_ROLES = ("worker", "server", "scheduler", "joint")
+VALID_ROLES = ("worker", "server", "scheduler", "replica", "joint")
 
 
 @dataclasses.dataclass
@@ -232,6 +232,34 @@ class Config:
     #   bounding a shared server's CPU burn, and the calibration lever
     #   the weighted-split QoS tests/bench use to create honest engine
     #   contention on loopback
+
+    # --- versioned snapshot serving (ISSUE 16; docs/serving.md) ------------
+    snapshot_retain: int = 4              # BYTEPS_SNAPSHOT_RETAIN
+    #   how many committed round-versioned snapshot cuts each server
+    #   retains per key (bounded ring; readers pinned to an evicted
+    #   version get a clean EVICTED miss and restart at the new
+    #   latest). 0 disables snapshot publication entirely — the
+    #   serving path then answers every pull NOT_COMMITTED
+    serving_weight: int = 1               # BYTEPS_SERVING_WEIGHT
+    #   DRR weight of the reader lane in the server engine: snapshot
+    #   pulls and replica delta requests share one low-weight lane, so
+    #   a reader swarm can never starve training pushes — served bytes
+    #   converge to serving_weight : sum(tenant weights)
+    replica_of: Optional[int] = None      # BYTEPS_REPLICA_OF
+    #   replica-process only: the server RANK (0-based) this read
+    #   replica subscribes to for snapshot deltas. Like
+    #   DMLC_RECOVER_RANK it is per-process identity owned by the
+    #   supervisor and is never projected fleet-wide
+    snap_delta_max_bytes: int = 16 << 20  # BYTEPS_SNAP_DELTA_MAX_BYTES
+    #   cap on one replica delta batch's raw payload; a catch-up larger
+    #   than this arrives as several whole-version batches
+    replica_poll_ms: int = 200            # BYTEPS_REPLICA_POLL_MS
+    #   replica -> primary delta poll period; also the re-dial backoff
+    #   after a lost primary connection
+    replica_lag_rounds: int = 8           # BYTEPS_REPLICA_LAG_ROUNDS
+    #   monitoring threshold: monitor.top flags a replica
+    #   REPLICA-LAGGING when its committed snapshot version trails its
+    #   primary's by more than this many rounds
 
     # --- chaos injection (deterministic fault harness; BYTEPS_CHAOS_*) -----
     chaos_seed: int = 0                   # BYTEPS_CHAOS_SEED
@@ -627,6 +655,57 @@ class Config:
                 "semantics after a recovery are undefined — set "
                 "BYTEPS_RECOVERY_TIMEOUT_MS=0 for async jobs",
                 stacklevel=2)
+        if self.snapshot_retain < 0:
+            raise ValueError(
+                "BYTEPS_SNAPSHOT_RETAIN must be >= 0 (0 disables "
+                "snapshot publication; N keeps the last N committed "
+                "round cuts per key)")
+        if self.serving_weight < 1:
+            raise ValueError(
+                "BYTEPS_SERVING_WEIGHT must be >= 1: the reader lane "
+                "needs a nonzero DRR weight or snapshot pulls would "
+                "never be scheduled at all (use a small weight to "
+                "deprioritize readers, not zero)")
+        if self.snap_delta_max_bytes < 4096:
+            raise ValueError(
+                "BYTEPS_SNAP_DELTA_MAX_BYTES must be >= 4096: a delta "
+                "batch always carries at least one whole version, so a "
+                "cap below one small tensor just adds per-batch "
+                "overhead without bounding anything")
+        if self.replica_poll_ms < 10:
+            raise ValueError(
+                "BYTEPS_REPLICA_POLL_MS must be >= 10 (the replica "
+                "delta poll period; sub-10ms polling busy-spins the "
+                "primary's serving lane)")
+        if self.replica_lag_rounds < 1:
+            raise ValueError(
+                "BYTEPS_REPLICA_LAG_ROUNDS must be >= 1 (the "
+                "REPLICA-LAGGING monitor threshold; a replica is "
+                "always legitimately one poll period behind)")
+        if self.replica_of is not None:
+            if self.role != "replica":
+                raise ValueError(
+                    "BYTEPS_REPLICA_OF is a replica-process knob (which "
+                    "server rank this read replica subscribes to); role "
+                    f"is {self.role!r}")
+            if not (0 <= self.replica_of < max(self.num_server, 1)):
+                raise ValueError(
+                    f"BYTEPS_REPLICA_OF={self.replica_of} out of range: "
+                    f"the fleet has {self.num_server} server rank(s) "
+                    f"(valid: 0..{max(self.num_server - 1, 0)})")
+        if self.role == "replica":
+            if self.snapshot_retain == 0:
+                raise ValueError(
+                    "role=replica with BYTEPS_SNAPSHOT_RETAIN=0: the "
+                    "primary publishes no snapshots, so the replica "
+                    "would have nothing to subscribe to and every pull "
+                    "would miss NOT_COMMITTED forever")
+            if self.enable_async:
+                raise ValueError(
+                    "role=replica with BYTEPS_ENABLE_ASYNC: snapshots "
+                    "are round-versioned consistent cuts, and async "
+                    "mode has no round boundaries to cut at — snapshot "
+                    "serving is a sync-mode feature")
         if self.heartbeat_interval_s > 0 and \
                 self.heartbeat_timeout_s <= self.heartbeat_interval_s:
             # A timeout at-or-below the interval declares healthy nodes
@@ -717,6 +796,14 @@ def load_config() -> Config:
         elastic=_env_bool("BYTEPS_ELASTIC"),
         elastic_timeout_ms=_env_int("BYTEPS_ELASTIC_TIMEOUT_MS", 30000),
         join_fleet=_env_bool("DMLC_JOIN"),
+        snapshot_retain=_env_int("BYTEPS_SNAPSHOT_RETAIN", 4),
+        serving_weight=_env_int("BYTEPS_SERVING_WEIGHT", 1),
+        replica_of=(int(os.environ["BYTEPS_REPLICA_OF"])
+                    if os.environ.get("BYTEPS_REPLICA_OF") else None),
+        snap_delta_max_bytes=_env_int("BYTEPS_SNAP_DELTA_MAX_BYTES",
+                                      16 << 20),
+        replica_poll_ms=_env_int("BYTEPS_REPLICA_POLL_MS", 200),
+        replica_lag_rounds=_env_int("BYTEPS_REPLICA_LAG_ROUNDS", 8),
         tenant_id=(int(os.environ["BYTEPS_TENANT_ID"])
                    if os.environ.get("BYTEPS_TENANT_ID") else None),
         tenant_name=_env_str("BYTEPS_TENANT_NAME", ""),

@@ -3,6 +3,7 @@ priority-credit scheduler, compression codecs. See csrc/ for the C++
 sources, build.py for compilation, ffi.py for the ctypes bindings."""
 
 from byteps_tpu.core.ffi import (  # noqa: F401
+    Replica,
     Scheduler,
     Server,
     Worker,

@@ -24,6 +24,7 @@
 #include "postoffice.h"
 #include "roundstats.h"
 #include "server.h"
+#include "snapshot.h"
 #include "tenancy.h"
 #include "trace.h"
 #include "worker.h"
@@ -115,9 +116,10 @@ std::string DefaultCompConfig() {
 
 extern "C" {
 
-// role: 0 scheduler, 1 server, 2 worker (Role enum). Returns node id, <0 on
-// error. All other configuration comes from the environment for parity with
-// the reference (see byteps_tpu/config.py and docs/ENV.md).
+// role: 0 scheduler, 1 server, 2 worker, 3 read replica (Role enum).
+// Returns node id, <0 on error. All other configuration comes from the
+// environment for parity with the reference (see byteps_tpu/config.py
+// and docs/ENV.md).
 int bps_init(int role) {
   InstallCrashHandler();
   Global* gl = g();
@@ -151,6 +153,20 @@ int bps_init(int role) {
         [gl](int kind, int affected, int64_t jr, int64_t jb, int tenant) {
           gl->server->OnFleetResize(kind, affected, jr, jb, tenant);
         });
+  } else if (gl->role == ROLE_REPLICA) {
+    // Read replica (ISSUE 16): a server engine in replica mode — it
+    // owns a SnapStore fed by primary deltas and the CMD_SNAP_* serve
+    // path, but never aggregates (no worker ever dials it for pushes).
+    // Same ordering rule as the server branch: engine threads before
+    // the postoffice accepts.
+    gl->server = std::make_unique<BytePSServer>();
+    gl->server->Start(gl->po.get(),
+                      EnvInt("BYTEPS_SERVER_ENGINE_THREAD", 4),
+                      /*async_mode=*/false,
+                      EnvInt("BYTEPS_REPLICA_OF", 0));
+    handler = [gl](Message&& m, int fd) {
+      gl->server->Handle(std::move(m), fd);
+    };
   } else if (gl->role == ROLE_WORKER) {
     gl->kv = std::make_unique<KVWorker>(
         gl->po.get(), EnvInt("BYTEPS_WORKER_CALLBACK_THREADS", 4));
@@ -241,6 +257,11 @@ int bps_init(int role) {
   Metrics::Get().Counter("bps_flight_dumps_total");
   if (gl->role == ROLE_SCHEDULER) {
     Metrics::Get().Counter("bps_round_summaries_ingested_total");
+  }
+  // Replica delta subscription starts only now: the poll loop dials the
+  // primary out of the address book, which exists only after Start.
+  if (gl->role == ROLE_REPLICA) {
+    gl->server->StartReplicaPoll();
   }
   gl->inited = true;
   return id;
@@ -1065,6 +1086,159 @@ int bps_wire_header_probe(int cmd, int tenant, long long key,
   h.version = version;
   if (buf) memcpy(buf, &h, sizeof(h));
   return static_cast<int>(sizeof(h));
+}
+
+// Snapshot-store probe (ISSUE 16; no topology needed): drives one
+// SnapStore — version monotonicity, complete-cut commit gating,
+// retention-ring eviction, replica watermark adoption, delta
+// collection — plus the CachedReplyValid stale-reply predicate through
+// a `;`-separated script and writes the final state as JSON (same
+// grow-the-buffer contract as the other probes). Ops:
+//   retain:N        set the retention ring depth
+//   publish:T,K,V   publish (tenant T, key K) at version V: 4 float32
+//                   elements all equal to V (+ a fake quant sidecar
+//                   when the op is `publishq`). Appends the Publish
+//                   return (accepted/rejected) to "published".
+//   publishq:T,K,V  as publish, with a quant sidecar attached
+//   force:V         ForceLatest(V) — the replica adoption path
+//   pull:T,K,V      Get (V = -1 means `latest`); appends
+//                   [code, resolved, first_float, has_quant] to "pulls"
+//   oldest:T,K      appends OldestOf to "oldest"
+//   collect:S,B     CollectNewer(since=S, max_bytes=B); appends
+//                   [entry_count, through] to "collects"
+//   tag:C,S,N       appends CachedReplyValid(cached=C, serve=S,
+//                   nonempty=N!=0) to "tags"
+// Output: {"latest":L,"keys":N,"publishes":P,"evictions":E,
+//          "published":[...],"pulls":[...],"oldest":[...],
+//          "collects":[...],"tags":[...]}. Returns the JSON length, or
+// -1 on a malformed script.
+long long bps_snap_probe(const char* script, char* buf,
+                         long long maxlen) {
+  if (!script) return -1;
+  SnapStore store;
+  std::vector<int> published;
+  std::vector<std::string> pulls, collects;
+  std::vector<long long> oldest;
+  std::vector<bool> tags;
+  const std::string s(script);
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t end = s.find(';', pos);
+    if (end == std::string::npos) end = s.size();
+    const std::string tok = s.substr(pos, end - pos);
+    pos = end + 1;
+    if (tok.empty()) continue;
+    const size_t colon = tok.find(':');
+    if (colon == std::string::npos) return -1;
+    const std::string op = tok.substr(0, colon);
+    const std::string val = tok.substr(colon + 1);
+    if (op == "retain") {
+      store.SetRetain(atoi(val.c_str()));
+    } else if (op == "selfcommit") {
+      // 0 = replica mode: publishes install but never advance `latest`
+      // (only ForceLatest, the adopted primary watermark, commits).
+      store.SetSelfCommit(atoi(val.c_str()) != 0);
+    } else if (op == "publish" || op == "publishq") {
+      long long t = 0, k = 0, v = 0;
+      if (sscanf(val.c_str(), "%lld,%lld,%lld", &t, &k, &v) != 3) {
+        return -1;
+      }
+      const float f = static_cast<float>(v);
+      const float raw[4] = {f, f, f, f};
+      // A recognizable fake quant sidecar: the version byte-repeated
+      // (the probe only asserts presence + fidelity, not the codec).
+      char quant[8];
+      memset(quant, static_cast<int>(v & 0x7f), sizeof(quant));
+      published.push_back(
+          store.Publish(static_cast<uint16_t>(t), k, v, BPS_FLOAT32,
+                        reinterpret_cast<const char*>(raw), sizeof(raw),
+                        op == "publishq" ? quant : nullptr,
+                        op == "publishq" ? sizeof(quant) : 0)
+              ? 1
+              : 0);
+    } else if (op == "force") {
+      store.ForceLatest(atoll(val.c_str()));
+    } else if (op == "pull") {
+      long long t = 0, k = 0, v = 0;
+      if (sscanf(val.c_str(), "%lld,%lld,%lld", &t, &k, &v) != 3) {
+        return -1;
+      }
+      SnapEntry e;
+      int64_t resolved = -1;
+      const int code =
+          store.Get(static_cast<uint16_t>(t), k, v, &e, &resolved);
+      float first = 0;
+      if (code == SnapStore::OK && e.raw && e.raw->size() >= 4) {
+        memcpy(&first, e.raw->data(), sizeof(first));
+      }
+      pulls.push_back("[" + std::to_string(code) + "," +
+                      std::to_string(resolved) + "," +
+                      std::to_string(static_cast<long long>(first)) +
+                      "," + (e.quant ? "true" : "false") + "]");
+    } else if (op == "oldest") {
+      long long t = 0, k = 0;
+      if (sscanf(val.c_str(), "%lld,%lld", &t, &k) != 2) return -1;
+      oldest.push_back(store.OldestOf(static_cast<uint16_t>(t), k));
+    } else if (op == "collect") {
+      long long since = 0, maxb = 0;
+      if (sscanf(val.c_str(), "%lld,%lld", &since, &maxb) != 2) {
+        return -1;
+      }
+      int64_t through = since;
+      const auto got = store.CollectNewer(
+          since, static_cast<size_t>(maxb), &through);
+      collects.push_back("[" + std::to_string(got.size()) + "," +
+                         std::to_string(through) + "]");
+    } else if (op == "tag") {
+      long long c = 0, sv = 0, ne = 0;
+      if (sscanf(val.c_str(), "%lld,%lld,%lld", &c, &sv, &ne) != 3) {
+        return -1;
+      }
+      tags.push_back(CachedReplyValid(c, sv, ne != 0));
+    } else {
+      return -1;
+    }
+  }
+  std::string out = "{\"latest\":" + std::to_string(store.latest());
+  out += ",\"keys\":" + std::to_string(store.key_count());
+  out += ",\"publishes\":" + std::to_string(store.publishes());
+  out += ",\"evictions\":" + std::to_string(store.evictions());
+  auto emit_list = [&out](const char* name,
+                          const std::vector<std::string>& items) {
+    out += std::string(",\"") + name + "\":[";
+    for (size_t i = 0; i < items.size(); ++i) {
+      if (i) out += ",";
+      out += items[i];
+    }
+    out += "]";
+  };
+  out += ",\"published\":[";
+  for (size_t i = 0; i < published.size(); ++i) {
+    if (i) out += ",";
+    out += std::to_string(published[i]);
+  }
+  out += "]";
+  emit_list("pulls", pulls);
+  out += ",\"oldest\":[";
+  for (size_t i = 0; i < oldest.size(); ++i) {
+    if (i) out += ",";
+    out += std::to_string(oldest[i]);
+  }
+  out += "]";
+  emit_list("collects", collects);
+  out += ",\"tags\":[";
+  for (size_t i = 0; i < tags.size(); ++i) {
+    if (i) out += ",";
+    out += tags[i] ? "true" : "false";
+  }
+  out += "]}";
+  const long long need = static_cast<long long>(out.size());
+  if (buf && maxlen > 0) {
+    long long n = need < maxlen - 1 ? need : maxlen - 1;
+    memcpy(buf, out.data(), static_cast<size_t>(n));
+    buf[n] = '\0';
+  }
+  return need;
 }
 
 // Record into the registry from outside the C core: kind is "counter"

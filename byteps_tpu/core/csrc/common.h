@@ -44,6 +44,12 @@ enum Role : int32_t {
   ROLE_SCHEDULER = 0,
   ROLE_SERVER = 1,
   ROLE_WORKER = 2,
+  // Snapshot serving (ISSUE 16): a read-only replica of one primary
+  // server's published snapshots. Rostered and heartbeat-monitored like
+  // any node, but outside the training data plane entirely: it never
+  // owns a key shard, never counts toward fleet formation, and its
+  // death costs readers a failover, never the fleet anything.
+  ROLE_REPLICA = 3,
 };
 
 constexpr int32_t kSchedulerId = 0;  // scheduler is always node 0
@@ -174,6 +180,31 @@ enum Command : int32_t {
                              // right after a re-issued CMD_ADDRBOOK,
                              // exactly like an elastic commit. Unparks
                              // the node's heartbeat loop.
+  // Versioned snapshot serving (ISSUE 16, docs/serving.md): read traffic
+  // against round-versioned immutable snapshots published by the server
+  // engine at each round boundary. All four are DATA-PLANE (retried,
+  // deduped, chaos-injectable) — a reader or replica losing a frame must
+  // ride the same absorption machinery as a training pull.
+  CMD_SNAP_PULL = 34,        // reader -> server/replica: request one
+                             // key's snapshot (version = requested
+                             // snapshot version, -1 for `latest`;
+                             // FLAG_WIRE_QUANT requests the quantized
+                             // serving encoding).
+  CMD_SNAP_RESP = 35,        // server/replica -> reader: version = the
+                             // served snapshot version (echoed so the
+                             // client can assert its cut), arg0 = miss
+                             // code (0 ok, 1 evicted/too old, 2 not yet
+                             // committed, 3 unknown key), arg1 = raw
+                             // float32 byte length when quantized.
+  CMD_SNAP_SUB = 36,         // replica -> primary: delta poll (arg0 =
+                             // highest snapshot version the replica
+                             // holds; -1 = empty, full catch-up).
+  CMD_SNAP_DELTA = 37,       // primary -> replica: batched snapshot
+                             // entries newer than the subscription
+                             // watermark (arg0 = entry count, payload =
+                             // SubHeader table + gathered float32
+                             // payloads, CMD_MULTI_* layout; version =
+                             // the primary's latest snapshot version).
 };
 
 // Transient-fault tolerance: commands eligible for chaos injection,
@@ -195,6 +226,12 @@ inline bool IsDataPlaneCmd(int32_t cmd) {
     // EPOCH_PAUSE/RESUME are control-plane: losing one would strand the
     // recovery, exactly like a lost heartbeat would fake a death.
     case CMD_RESEED:
+    // Snapshot serving (ISSUE 16): reads and replica delta traffic are
+    // data plane by the same argument — a dropped SNAP_PULL retries
+    // like a training pull, a replayed SNAP_DELTA re-installs an
+    // identical immutable snapshot entry (idempotent assignment).
+    case CMD_SNAP_PULL: case CMD_SNAP_RESP:
+    case CMD_SNAP_SUB: case CMD_SNAP_DELTA:
       return true;
     default:
       return false;

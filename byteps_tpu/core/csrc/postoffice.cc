@@ -340,6 +340,17 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
                           "(DMLC_JOIN set) — awaiting the scheduler's "
                           "membership epoch";
     }
+    // Read replica (ISSUE 16): rostered like any node — heartbeats,
+    // address book, shutdown broadcast — but OUTSIDE the training
+    // roster: never counted into num_workers_/num_servers_, never a
+    // formation participant. h.arg0 carries the primary's server rank.
+    if (role == ROLE_REPLICA) {
+      const char* ro = getenv("BYTEPS_REPLICA_OF");
+      h.arg0 = ro && *ro ? atol(ro) : 0;
+      h.version = 2;  // replica-registration marker
+      BPS_LOG(WARNING) << "replica: registering as read replica of "
+                          "server rank " << h.arg0;
+    }
     van_->Send(fd, h, &me, sizeof(me));
     // Wait for the address book (same formation bound as the scheduler).
     std::unique_lock<std::mutex> lk(mu_);
@@ -403,6 +414,10 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
     // elastic tests read them.
     Metrics::Get().Counter("bps_worker_joins_total");
     Metrics::Get().Counter("bps_worker_leaves_total");
+    // Snapshot serving (ISSUE 16): replica roster size + death count,
+    // from zero — monitor.top's fleet header reads the gauge.
+    Metrics::Get().Gauge("bps_fleet_replicas");
+    Metrics::Get().Counter("bps_replica_deaths_total");
     Metrics::Get().Gauge("bps_fleet_workers");
     Metrics::Get().Gauge("bps_fleet_tenants");
     Metrics::Get().Gauge("bps_fleet_resizing");
@@ -441,6 +456,45 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
         if (NowMs() < next_check_ms) continue;
         next_check_ms = NowMs() + static_cast<int64_t>(interval * 1000);
         auto dead = DeadNodes();
+        // Replica deaths are free (ISSUE 16): a read replica carries no
+        // training state, so its loss must never enter the
+        // recoverable/shrinkable/fail-stop classification below — its
+        // readers fail over to another endpoint, the fleet does not
+        // even pause. Drop it from the roster and move on.
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          for (auto it = dead.begin(); it != dead.end();) {
+            int rid = *it;
+            bool is_replica = false;
+            for (const auto& n : nodes_) {
+              if (n.id == rid && n.role == ROLE_REPLICA) {
+                is_replica = true;
+                break;
+              }
+            }
+            if (!is_replica) {
+              ++it;
+              continue;
+            }
+            BPS_LOG(WARNING) << "scheduler: read replica " << rid
+                             << " missed heartbeats — dropped from the "
+                                "roster (readers fail over; the "
+                                "training fleet is unaffected)";
+            last_heartbeat_ms_.erase(rid);
+            node_fd_.erase(rid);
+            departed_.insert(rid);
+            nodes_.erase(std::remove_if(nodes_.begin(), nodes_.end(),
+                                        [rid](const NodeInfo& n) {
+                                          return n.id == rid;
+                                        }),
+                         nodes_.end());
+            replica_count_ -= 1;
+            BPS_METRIC_GAUGE_SET("bps_fleet_replicas", replica_count_);
+            BPS_METRIC_COUNTER_ADD("bps_replica_deaths_total", 1);
+            Trace::Get().Note("REPLICA_LOST", 0, rid);
+            it = dead.erase(it);
+          }
+        }
         if (dead.empty()) continue;
         // Recoverable: exactly one dead node, it is a server, and hot
         // replacement is armed. (Simultaneous multi-server death is out
@@ -511,6 +565,24 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
         HandleRecoverRegister(fd, info, static_cast<int>(msg.head.arg0));
         break;
       }
+      if (role_ == ROLE_SCHEDULER && msg.head.version == 2) {
+        // A read replica registering (ISSUE 16): rostered (heartbeats,
+        // book entry, shutdown broadcast) but NOT a formation
+        // participant — it never counts toward pending_regs_, and a
+        // replica arriving before the training fleet has formed is
+        // parked until the book exists to answer with.
+        BPS_CHECK_EQ(msg.payload.size(), sizeof(NodeInfo));
+        NodeInfo info{};
+        memcpy(&info, msg.payload.data(), sizeof(NodeInfo));
+        std::lock_guard<std::mutex> lk(mu_);
+        if (!addrbook_ready_) {
+          buffered_replicas_.push_back(
+              {info, fd, static_cast<int>(msg.head.arg0)});
+        } else {
+          AdmitReplicaLocked(fd, info, static_cast<int>(msg.head.arg0));
+        }
+        break;
+      }
       if (role_ == ROLE_SCHEDULER) {
         std::unique_lock<std::mutex> lk(mu_);
         BPS_CHECK_EQ(msg.payload.size(), sizeof(NodeInfo));
@@ -557,6 +629,12 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
           // Elastic rank allocation starts past the formation ranks:
           // joined workers get fresh, never-reused ranks/ids.
           next_worker_rank_ = next_worker;
+          // Replicas that raced formation parked here; admit them now
+          // that there is a book to answer with.
+          for (const auto& br : buffered_replicas_) {
+            AdmitReplicaLocked(br.fd, br.info, br.primary);
+          }
+          buffered_replicas_.clear();
           cv_.notify_all();
           // Tenant roster (ISSUE 9): feed node->tenant into the
           // round-summary layer (insight tags rounds by tenant) and
@@ -1189,6 +1267,57 @@ void Postoffice::HandleRecoverRegister(int fd, const NodeInfo& info,
                    << epoch_.load() << ")";
   Trace::Get().Note("EPOCH_RESUME", epoch_.load(), id);
   Trace::Get().FlightDumpAuto("epoch_resume");
+}
+
+// --- read-replica admission (ISSUE 16) --------------------------------------
+
+void Postoffice::AdmitReplicaLocked(int fd, const NodeInfo& info_in,
+                                    int primary_rank) {
+  // A replica rides the elastic rank allocator: a fresh, never-reused
+  // id past every training rank, so nothing in the worker/server id
+  // arithmetic can collide with it. It joins the roster (book entry,
+  // heartbeat row, shutdown broadcast) but neither the formation count
+  // nor num_workers_ — the CMD_ADDRBOOK handler counts ROLE_WORKER
+  // entries only, so every node's divisor stays untouched.
+  if (primary_rank < 0 || primary_rank >= num_servers_) {
+    BPS_LOG(WARNING) << "scheduler: replica registered with "
+                        "out-of-range BYTEPS_REPLICA_OF=" << primary_rank
+                     << " (fleet has " << num_servers_
+                     << " servers) — admitted anyway; it will idle "
+                        "until a valid primary exists";
+  }
+  NodeInfo adopted = info_in;
+  const int id = WorkerId(next_worker_rank_++);
+  adopted.id = id;
+  adopted.role = ROLE_REPLICA;
+  nodes_.push_back(adopted);
+  node_fd_[id] = fd;
+  last_heartbeat_ms_[id] = NowMs();
+  replica_count_ += 1;
+  BPS_METRIC_GAUGE_SET("bps_fleet_replicas", replica_count_);
+  Trace::Get().Instant("register", id, id, -1, ROLE_REPLICA);
+  Trace::Get().Note("REPLICA_ADMIT", primary_rank, id);
+  // Direct book, recovery-registration style: formation (if any)
+  // already happened and must not be re-opened for a read-only node.
+  MsgHeader ab{};
+  ab.cmd = CMD_ADDRBOOK;
+  ab.sender = kSchedulerId;
+  ab.arg0 = id;
+  van_->Send(fd, ab, nodes_.data(), nodes_.size() * sizeof(NodeInfo));
+  BPS_LOG(WARNING) << "scheduler: admitted read replica " << id
+                   << " at " << adopted.host << ":" << adopted.port
+                   << " (primary server rank " << primary_rank << ")";
+}
+
+bool Postoffice::NodeOf(int node_id, NodeInfo* out) {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (const auto& n : nodes_) {
+    if (n.id == node_id) {
+      if (out) *out = n;
+      return true;
+    }
+  }
+  return false;
 }
 
 // --- scheduler fail-over (ISSUE 15) -----------------------------------------

@@ -206,6 +206,11 @@ class Postoffice {
   // Full roster: tenant -> (live worker count, weight).
   std::map<uint16_t, std::pair<int, int>> TenantRoster();
 
+  // Address-book lookup by node id (ISSUE 16: a replica dials its
+  // primary from the LIVE book, so a hot-replaced primary resolves to
+  // the replacement's endpoint). False when the id is not in the book.
+  bool NodeOf(int node_id, NodeInfo* out);
+
   // Worker/server ids the scheduler considers dead (missed heartbeats).
   std::vector<int> DeadNodes();
   // Scheduler-side heartbeat freshness: (node id, ms since last beat)
@@ -246,6 +251,11 @@ class Postoffice {
   // the recovery marker) — adopt it: assign the dead rank's id, update
   // the address book, reply ADDRBOOK, broadcast CMD_EPOCH_RESUME.
   void HandleRecoverRegister(int fd, const NodeInfo& info, int rank);
+  // Scheduler: admit a read replica (ISSUE 16) — fresh elastic rank,
+  // roster + heartbeat row, direct ADDRBOOK reply. Never a formation
+  // participant and never counted into num_workers_/num_servers_.
+  // Caller holds mu_.
+  void AdmitReplicaLocked(int fd, const NodeInfo& info, int primary_rank);
   // Scheduler: the fail-stop broadcast (failure SHUTDOWN, arg0=1) —
   // shared by the heartbeat monitor and the recovery-timeout fallback.
   // Caller holds mu_.
@@ -305,6 +315,11 @@ class Postoffice {
   // scheduler state
   struct PendingReg { int fd; NodeInfo info; };
   std::vector<PendingReg> pending_regs_;
+  // Read replicas that registered before fleet formation completed
+  // (ISSUE 16): parked until there is an address book to answer with.
+  struct BufferedReplica { NodeInfo info{}; int fd = -1; int primary = 0; };
+  std::vector<BufferedReplica> buffered_replicas_;
+  int replica_count_ = 0;  // live admitted replicas (guarded by mu_)
   std::map<int, int> barrier_counts_;      // group -> count
   std::unordered_map<int, int64_t> last_heartbeat_ms_;  // node id -> ts
   std::unordered_set<int> departed_;       // clean goodbyes: never "dead"

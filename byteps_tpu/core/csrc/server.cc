@@ -22,9 +22,43 @@ namespace {
 constexpr int32_t kCmdShrink = -100;
 }  // namespace
 
-void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
+void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode,
+                         int replica_of) {
   po_ = po;
   async_ = async_mode;
+  replica_of_ = replica_of;
+  // Snapshot serving (ISSUE 16): retention ring depth (0 = serving off
+  // on this node), the reader lane's DRR weight, and the per-frame
+  // delta bound for replica catch-up.
+  if (const char* sr = getenv("BYTEPS_SNAPSHOT_RETAIN")) {
+    snapshot_retain_ = atoi(sr);
+    if (snapshot_retain_ < 0) snapshot_retain_ = 0;
+  }
+  if (snapshot_retain_ > 0) snaps_.SetRetain(snapshot_retain_);
+  if (const char* sw = getenv("BYTEPS_SERVING_WEIGHT")) {
+    serving_weight_ = atoll(sw);
+    if (serving_weight_ < 1) serving_weight_ = 1;
+  }
+  if (const char* db = getenv("BYTEPS_SNAP_DELTA_MAX_BYTES")) {
+    const int64_t v = atoll(db);
+    if (v > 0) snap_delta_max_bytes_ = v;
+  }
+  if (replica_of_ >= 0) {
+    // A replica is outside the training plane entirely: it must never
+    // publish cuts of its own (its store mirrors the primary's) and
+    // serving must be armed or the process would do nothing at all.
+    BPS_CHECK_GT(snapshot_retain_, 0)
+        << "replica started with BYTEPS_SNAPSHOT_RETAIN=0 — a replica "
+           "with serving disabled cannot do anything";
+    // The replica's `latest` advances ONLY via the primary's committed
+    // watermark (ForceLatest after a whole delta batch lands) — per-key
+    // self-commit counting on a partially installed batch would let a
+    // reader resolve a cut whose keys are not all there yet.
+    snaps_.SetSelfCommit(false);
+    BPS_LOG(WARNING) << "server: starting as READ REPLICA of server rank "
+                     << replica_of_ << " (retain " << snapshot_retain_
+                     << " round(s))";
+  }
   // Quantized wire (ISSUE 6): same env the worker reads, same backstop
   // clamp, so both ends compute identical per-key eligibility.
   if (const char* qv = getenv("BYTEPS_WIRE_QUANT")) {
@@ -110,6 +144,16 @@ void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
         "bps_round_parked"}) {
     Metrics::Get().Gauge(g);
   }
+  // Snapshot-serving series (ISSUE 16), present from zero on every
+  // server/replica (docs/monitoring.md): the committed cut version,
+  // publication/read/eviction counters, and the replica's lag behind
+  // its primary's committed version (always 0 on a primary).
+  Metrics::Get().Counter("bps_snap_pulls_total");
+  Metrics::Get().Counter("bps_snap_publish_total");
+  Metrics::Get().Counter("bps_snap_evictions_total");
+  Metrics::Get().Gauge("bps_snapshot_version");
+  Metrics::Get().Gauge("bps_replica_lag_rounds");
+  BPS_METRIC_GAUGE_SET("bps_snapshot_version", -1);
   queues_.clear();
   // DRR weights resolve through the address book at grant time (ISSUE
   // 9): a tenant's BYTEPS_TENANT_WEIGHT rides its workers' NodeInfo
@@ -118,7 +162,14 @@ void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode) {
   for (int i = 0; i < engine_threads; ++i) {
     queues_.push_back(std::make_unique<EngineQueue>(
         TenantQuantum(),
-        [this](uint16_t t) { return po_ ? po_->TenantWeightOf(t) : 1; }));
+        // The reserved serving lane resolves to BYTEPS_SERVING_WEIGHT
+        // (ISSUE 16) — reader traffic shares the engine at a fixed
+        // capped ratio against every tenant lane; training tenants
+        // resolve through the address book as before.
+        [this](uint16_t t) {
+          if (t == kServingLane) return static_cast<int>(serving_weight_);
+          return po_ ? po_->TenantWeightOf(t) : 1;
+        }));
   }
   for (int i = 0; i < engine_threads; ++i) {
     threads_.emplace_back([this, i] { EngineLoop(i); });
@@ -142,6 +193,22 @@ void BytePSServer::Handle(Message&& msg, int fd) {
   } else if (msg.head.cmd == CMD_PULL) {
     BPS_METRIC_COUNTER_ADD("bps_server_pull_total", 1);
   }
+  // Snapshot serving (ISSUE 16): reader/replica traffic rides the
+  // reserved low-weight serving lane, NOT the frame's tenant lane —
+  // QoS isolation is what makes a reader swarm provably unable to move
+  // the training digest. Its ops land in the LANE's accounting too, so
+  // the per-lane tables show reader load separately from any tenant.
+  // The header's tenant is untouched (the store lookup and the reply
+  // stamping still need it).
+  if (msg.head.cmd == CMD_SNAP_PULL || msg.head.cmd == CMD_SNAP_SUB ||
+      msg.head.cmd == CMD_SNAP_DELTA) {
+    Tenancy::Get().Of(kServingLane)->ops.fetch_add(
+        1, std::memory_order_relaxed);
+    Trace::Get().Instant("s_recv", msg.head.key, msg.head.sender,
+                         msg.head.req_id, msg.head.cmd);
+    EnqueueTask(EngineTask{std::move(msg), fd, nullptr, -1}, kServingLane);
+    return;
+  }
   // Per-tenant accounting (ISSUE 9): ops and push payload bytes by the
   // frame's tenant stamp.
   {
@@ -160,8 +227,12 @@ void BytePSServer::Handle(Message&& msg, int fd) {
   EnqueueTask(EngineTask{std::move(msg), fd, nullptr, -1});
 }
 
-void BytePSServer::EnqueueTask(EngineTask&& task) {
+void BytePSServer::EnqueueTask(EngineTask&& task, int lane) {
   const uint16_t tenant = task.msg.head.tenant;
+  // The DRR lane this task is accounted/dispatched under: the frame's
+  // tenant, unless the caller overrides it (serving lane, ISSUE 16).
+  const uint16_t drr_lane =
+      lane < 0 ? tenant : static_cast<uint16_t>(lane);
   // Route by (tenant, key) so one tenant-key's operations are totally
   // ordered on one thread. Tenant 0 composes to the bare key — the
   // pre-tenant `key % threads` routing, bit for bit.
@@ -170,13 +241,13 @@ void BytePSServer::EnqueueTask(EngineTask&& task) {
       queues_.size();
   const int64_t cost =
       DrrCost(static_cast<int64_t>(task.msg.payload.size()));
-  TenantStat* ts = Tenancy::Get().Of(tenant);
+  TenantStat* ts = Tenancy::Get().Of(drr_lane);
   ts->queue_depth.fetch_add(1, std::memory_order_relaxed);
   auto& eq = *queues_[tid];
   {
     std::lock_guard<std::mutex> lk(eq.mu);
-    eq.lanes[tenant].push_back(std::move(task));
-    eq.drr.Enqueue(tenant, cost);
+    eq.lanes[drr_lane].push_back(std::move(task));
+    eq.drr.Enqueue(drr_lane, cost);
   }
   eq.cv.notify_one();
 }
@@ -665,12 +736,41 @@ void BytePSServer::AnswerDuplicate(KeyStore* ks, KeyStore::SenderRec& rec,
         return;
       }
       int slot = h.version & 1;
-      if (ks->round[slot] == h.version || ks->last_round[slot] == h.version) {
-        if (head.flags & FLAG_COMPRESSED) {
+      // Round-tag assertion on every cached-encode replay (ISSUE 16
+      // satellite): the slot's cache can already hold the NEXT round's
+      // re-encode while last_round still names this one (new round
+      // READY, not yet recycled). Replaying those bytes under this
+      // h.version header would hand the worker a silently wrong round
+      // — and since the new encode implies the new round also assigned
+      // over the raw slot, falling back to slot bytes is no better.
+      // Tag == h.version → replay the cache. Tag cleared (-1, a
+      // re-seed) → the restored raw slot IS the round's truth; serve
+      // it honestly declared. Tag naming another round → the replay
+      // window is outrun; fail loud below, never serve torn bytes.
+      const int64_t ctag = ks->comp_reply_round[slot];
+      const int64_t qtag = ks->qreply_round[slot];
+      const bool comp_outrun =
+          (head.flags & FLAG_COMPRESSED) && ctag >= 0 && ctag != h.version;
+      const bool quant_outrun =
+          (head.flags & FLAG_WIRE_QUANT) && qtag >= 0 && qtag != h.version;
+      if ((ks->round[slot] == h.version ||
+           ks->last_round[slot] == h.version) &&
+          !comp_outrun && !quant_outrun) {
+        if ((head.flags & FLAG_COMPRESSED) &&
+            CachedReplyValid(ctag, h.version,
+                             !ks->comp_reply[slot].empty())) {
           SendReply(task, head, ks->comp_reply[slot].data(),
                     static_cast<int64_t>(ks->comp_reply[slot].size()));
+        } else if (head.flags & FLAG_COMPRESSED) {
+          // Encode re-seeded away: the restored raw aggregate is the
+          // round's truth; declare it raw.
+          head.flags &= ~FLAG_COMPRESSED;
+          head.arg0 = 0;
+          SendReply(task, head, ks->slot[slot].data(),
+                    static_cast<int64_t>(ks->slot[slot].size()));
         } else if ((head.flags & FLAG_WIRE_QUANT) &&
-                   !ks->qreply[slot].empty()) {
+                   CachedReplyValid(qtag, h.version,
+                                    !ks->qreply[slot].empty())) {
           // Replay the round's cached quantized encode — the same
           // bytes the original reply carried.
           SendReply(task, head, ks->qreply[slot].data(),
@@ -757,6 +857,7 @@ void BytePSServer::Process(EngineTask&& task) {
         if (!ks) {
           ks = std::make_unique<KeyStore>();
           ks->tenant = h.tenant;
+          ks->key = h.key;
           ks->len = h.arg0;
           ks->dtype = h.dtype;
           ks->comp_config.assign(msg.payload.begin(), msg.payload.end());
@@ -1077,10 +1178,15 @@ void BytePSServer::Process(EngineTask&& task) {
           if (elastic_) ks->er[slot].Reset();
         }
         ks->comp_reply[slot].clear();
+        ks->comp_reply_round[slot] = -1;
         // The quantized-reply cache is stale too: a re-seeded slot
         // serves the authoritative float32 bytes raw (the reseed IS
         // what the fault-free workers decoded — see ServeRetainedPull).
+        // Tags go to -1 with the bytes: "cleared by re-seed" is the one
+        // mismatch the serve sites answer with raw instead of a
+        // replay-window error.
         ks->qreply[slot].clear();
+        ks->qreply_round[slot] = -1;
         // Pulls for this round parked before the reseed landed are
         // servable now.
         std::vector<EngineTask> waiting;
@@ -1173,8 +1279,239 @@ void BytePSServer::Process(EngineTask&& task) {
       break;
     }
 
+    // Snapshot serving (ISSUE 16). All three are read-only against the
+    // immutable SnapStore and idempotent by construction — a chaos dup
+    // or retry re-resolves to the same bytes — so they deliberately
+    // skip the per-key dedup window above.
+    case CMD_SNAP_PULL:
+      ProcessSnapPull(task);
+      break;
+    case CMD_SNAP_SUB:
+      ProcessSnapSub(task);
+      break;
+    case CMD_SNAP_DELTA:
+      ProcessSnapDelta(task);
+      break;
+
     default:
       BPS_LOG(WARNING) << "server: unexpected cmd " << h.cmd;
+  }
+}
+
+void BytePSServer::ProcessSnapPull(EngineTask& task) {
+  const MsgHeader& h = task.msg.head;
+  SnapEntry ent;
+  int64_t resolved = -1;
+  SnapStore::Code code =
+      snapshot_retain_ > 0
+          ? snaps_.Get(h.tenant, h.key, h.version, &ent, &resolved)
+          : SnapStore::NOT_COMMITTED;
+  MsgHeader resp{};
+  resp.cmd = CMD_SNAP_RESP;
+  resp.tenant = h.tenant;
+  resp.sender = po_->my_id();
+  resp.key = h.key;
+  resp.req_id = h.req_id;
+  // The CUT the reply answers for — echoed even on a miss, so a client
+  // pinned to a version can assert every reply against it. On a
+  // `latest` request this is the resolved committed version the client
+  // then pins for the rest of its cut.
+  resp.version = static_cast<int32_t>(resolved);
+  resp.arg0 = code;
+  BPS_METRIC_COUNTER_ADD("bps_snap_pulls_total", 1);
+  if (code != SnapStore::OK) {
+    po_->van().Send(task.fd, resp);
+    return;
+  }
+  resp.dtype = ent.dtype;
+  const bool want_quant = (h.flags & FLAG_WIRE_QUANT) != 0;
+  const std::vector<char>* body;
+  if (want_quant && ent.quant) {
+    // Quantized serving default (EQuARX, PAPERS.md): the SAME cached
+    // BlockQuant bytes the training pull leg ships — primary and
+    // replica replies are byte-identical because the encode travels
+    // with the delta instead of being redone per node.
+    resp.flags = FLAG_WIRE_QUANT;
+    resp.arg1 = static_cast<int64_t>(ent.raw->size());  // decoded size
+    body = ent.quant.get();
+  } else {
+    // float32 opt-out (no FLAG_WIRE_QUANT in the request), or a
+    // quant-ineligible key: the raw aggregate, declared as such.
+    body = ent.raw.get();
+  }
+  // Reader reply accounting lands on the SERVING lane, not the tenant
+  // stamp: tenant reply_bytes feed the training QoS split tables and a
+  // reader swarm must not skew them.
+  Tenancy::Get().Of(kServingLane)->reply_bytes.fetch_add(
+      static_cast<int64_t>(body->size()), std::memory_order_relaxed);
+  BPS_METRIC_COUNTER_ADD("bps_server_reply_bytes_total",
+                         static_cast<int64_t>(body->size()));
+  po_->van().Send(task.fd, resp, body->data(),
+                  static_cast<int64_t>(body->size()));
+}
+
+void BytePSServer::ProcessSnapSub(EngineTask& task) {
+  const MsgHeader& h = task.msg.head;
+  int64_t through = h.arg0;
+  std::vector<SnapDeltaEnt> delta =
+      snaps_.CollectNewer(h.arg0, static_cast<size_t>(snap_delta_max_bytes_),
+                          &through);
+  // CMD_MULTI-style layout: SubHeader table + gathered payloads. Each
+  // entry's payload is raw float32 followed by the cached quantized
+  // encode (arg0 = the raw length, len = both), so the replica serves
+  // byte-identical replies without re-encoding.
+  const int count = static_cast<int>(delta.size());
+  std::vector<SubHeader> table(static_cast<size_t>(count));
+  std::vector<iovec> segs;
+  segs.reserve(static_cast<size_t>(count) * 2 + 1);
+  segs.push_back({table.data(),
+                  static_cast<size_t>(count) * sizeof(SubHeader)});
+  int64_t off = 0;
+  for (int i = 0; i < count; ++i) {
+    const SnapDeltaEnt& d = delta[static_cast<size_t>(i)];
+    SubHeader& s = table[static_cast<size_t>(i)];
+    s.key = d.key;
+    s.cmd = CMD_SNAP_DELTA;
+    s.version = static_cast<int32_t>(d.entry.version);
+    s.dtype = static_cast<int16_t>(d.entry.dtype);
+    s.tenant = d.tenant;
+    s.arg0 = static_cast<int64_t>(d.entry.raw->size());
+    const int64_t qlen =
+        d.entry.quant ? static_cast<int64_t>(d.entry.quant->size()) : 0;
+    s.len = s.arg0 + qlen;
+    s.offset = off;
+    off += s.len;
+    segs.push_back({const_cast<char*>(d.entry.raw->data()),
+                    d.entry.raw->size()});
+    if (qlen > 0) {
+      segs.push_back({const_cast<char*>(d.entry.quant->data()),
+                      d.entry.quant->size()});
+    }
+  }
+  MsgHeader resp{};
+  resp.cmd = CMD_SNAP_DELTA;
+  resp.tenant = h.tenant;
+  resp.sender = po_->my_id();
+  resp.key = h.key;
+  resp.req_id = h.req_id;
+  resp.arg0 = count;
+  // version = the watermark this batch advances the replica to (the
+  // last FULLY included version — a partial batch must not claim the
+  // primary's latest); arg1 = the primary's committed latest, the
+  // replica's lag gauge numerator.
+  resp.version = static_cast<int32_t>(through);
+  resp.arg1 = snaps_.latest();
+  Tenancy::Get().Of(kServingLane)->reply_bytes.fetch_add(
+      off, std::memory_order_relaxed);
+  po_->van().SendV(task.fd, resp, segs.data(),
+                   static_cast<int>(segs.size()));
+}
+
+void BytePSServer::ProcessSnapDelta(EngineTask& task) {
+  Message& msg = task.msg;
+  const MsgHeader& h = msg.head;
+  const int count = static_cast<int>(h.arg0);
+  if (count < 0 ||
+      static_cast<int64_t>(count) * static_cast<int64_t>(sizeof(SubHeader)) >
+          static_cast<int64_t>(msg.payload.size())) {
+    BPS_LOG(WARNING) << "replica: malformed snapshot delta (count="
+                     << count << ", payload=" << msg.payload.size()
+                     << ") — dropped; the next poll repairs";
+    return;
+  }
+  const SubHeader* table =
+      reinterpret_cast<const SubHeader*>(msg.payload.data());
+  const int64_t table_bytes =
+      static_cast<int64_t>(count) * static_cast<int64_t>(sizeof(SubHeader));
+  const char* gathered = msg.payload.data() + table_bytes;
+  const int64_t gathered_len =
+      static_cast<int64_t>(msg.payload.size()) - table_bytes;
+  for (int i = 0; i < count; ++i) {
+    const SubHeader& s = table[i];
+    if (s.offset < 0 || s.len < 0 || s.arg0 < 0 || s.arg0 > s.len ||
+        s.offset + s.len > gathered_len) {
+      BPS_LOG(WARNING) << "replica: snapshot delta entry out of range "
+                          "(key " << s.key << ") — frame dropped";
+      return;
+    }
+    // Publish is idempotent and append-only, so a chaos-duplicated or
+    // re-polled delta re-installs harmlessly.
+    snaps_.Publish(s.tenant, s.key, s.version, s.dtype,
+                   gathered + s.offset, static_cast<size_t>(s.arg0),
+                   s.len > s.arg0 ? gathered + s.offset + s.arg0 : nullptr,
+                   static_cast<size_t>(s.len - s.arg0));
+  }
+  // Adopt the primary's committed watermark for this batch: every entry
+  // up to `version` is now held, so `latest` may advance even when this
+  // replica joined mid-history and per-key commit counting would never
+  // converge on the evicted prefix.
+  snaps_.ForceLatest(h.version);
+  const int64_t lag = h.arg1 >= 0 ? h.arg1 - snaps_.latest() : 0;
+  BPS_METRIC_GAUGE_SET("bps_replica_lag_rounds", lag > 0 ? lag : 0);
+  BPS_METRIC_GAUGE_SET("bps_snapshot_version", snaps_.latest());
+  if (count > 0) {
+    Trace::Get().Note("SNAP_DELTA", count, static_cast<int>(h.version));
+  }
+}
+
+void BytePSServer::StartReplicaPoll() {
+  if (replica_of_ < 0) return;
+  replica_thread_ = std::thread([this] { ReplicaPollLoop(); });
+}
+
+void BytePSServer::ReplicaPollLoop() {
+  const int primary_id = Postoffice::ServerId(replica_of_);
+  long poll_ms = 200;
+  if (const char* pv = getenv("BYTEPS_REPLICA_POLL_MS")) {
+    const long v = atol(pv);
+    if (v > 0) poll_ms = v;
+  }
+  int fd = -1;
+  while (!stopped_.load() && !po_->ShuttingDown()) {
+    if (fd < 0) {
+      // (Re-)dial the primary from the LIVE address book — a
+      // hot-replaced primary (ISSUE 4) re-enters here with its
+      // replacement's address. The hello registers this fd on the
+      // primary like any worker stripe.
+      NodeInfo primary{};
+      if (!po_->NodeOf(primary_id, &primary)) {
+        BPS_LOG(WARNING) << "replica: primary server rank " << replica_of_
+                         << " not in the address book yet";
+        usleep(static_cast<useconds_t>(poll_ms) * 1000);
+        continue;
+      }
+      fd = po_->van().Connect(primary.host, primary.port);
+      if (fd < 0) {
+        usleep(static_cast<useconds_t>(poll_ms) * 1000);
+        continue;
+      }
+      MsgHeader hello{};
+      hello.cmd = CMD_REGISTER;
+      hello.sender = po_->my_id();
+      hello.arg1 = ROLE_REPLICA;
+      po_->van().Send(fd, hello);
+    }
+    MsgHeader sub{};
+    sub.cmd = CMD_SNAP_SUB;
+    sub.sender = po_->my_id();
+    sub.req_id = 0;
+    // Watermark: the highest version we hold; -1 on a fresh join means
+    // "everything you have" — the full-state catch-up.
+    sub.arg0 = snaps_.latest();
+    if (!po_->van().Send(fd, sub)) {
+      // Dead primary connection: drop the fd and re-dial next tick
+      // (the book may meanwhile be updated with a hot replacement). A
+      // replica never escalates — its readers fail over, the fleet
+      // never notices.
+      BPS_LOG(WARNING) << "replica: lost primary connection — "
+                          "re-dialing from the address book";
+      fd = -1;
+      continue;
+    }
+    for (long slept = 0; slept < poll_ms && !stopped_.load();
+         slept += 50) {
+      usleep(50 * 1000);
+    }
   }
 }
 
@@ -1238,9 +1575,13 @@ void BytePSServer::ServeRetainedPull(KeyStore* ks, int slot,
   // Mean divisor of the RETAINED round (set at recycle / reseed).
   resp.arg1 = ks->last_contrib_n[slot] > 0 ? ks->last_contrib_n[slot]
                                            : ks->contrib_n[slot];
-  if (ks->reply_comp && !ks->comp_reply[slot].empty()) {
+  if (ks->reply_comp &&
+      CachedReplyValid(ks->comp_reply_round[slot], req.version,
+                       !ks->comp_reply[slot].empty())) {
     // Normal-operation replay window: the cached encode is still valid
-    // for this round. (A re-seeded slot clears it and serves raw.)
+    // AND tagged with this exact round. (A re-seeded slot clears it —
+    // and a tag minted for a different round must never replay here —
+    // either way the authoritative raw bytes below serve instead.)
     resp.flags = FLAG_COMPRESSED;
     resp.arg0 = ks->len;
     BPS_METRIC_COUNTER_ADD(
@@ -1250,7 +1591,8 @@ void BytePSServer::ServeRetainedPull(KeyStore* ks, int slot,
     SendReply(t, resp, ks->comp_reply[slot].data(),
               ks->comp_reply[slot].size());
   } else if ((req.flags & FLAG_WIRE_QUANT) &&
-             !ks->qreply[slot].empty()) {
+             CachedReplyValid(ks->qreply_round[slot], req.version,
+                              !ks->qreply[slot].empty())) {
     // Quantized replay window (same rule as comp_reply above); a
     // re-seeded slot cleared the cache and serves the authoritative
     // float32 below — which is byte-identical to what the fault-free
@@ -1298,11 +1640,35 @@ void BytePSServer::RoundReady(KeyStore* ks, int slot) {
         reinterpret_cast<const float*>(ks->slot[slot].data()),
         ks->len / static_cast<int64_t>(sizeof(float)),
         &ks->comp_reply[slot]);
+    ks->comp_reply_round[slot] = ks->round[slot];
   } else if (ks->quant_ok) {
     // Re-quantize the aggregate once per round; every flagged pull
     // (and every dedup replay) serves the same cached bytes, so
     // replies stay deterministic under chaos.
     EncodeQuantReply(ks, slot);
+    ks->qreply_round[slot] = ks->round[slot];
+  }
+  // Snapshot publication (ISSUE 16): the finished aggregate becomes the
+  // round's immutable serving cut. Copy-on-publish — readers share the
+  // SnapStore's copy, never this slot, which the engine is about to
+  // keep mutating. The cached quant encode travels along so a replica
+  // serves byte-identical quantized replies. A replica never publishes
+  // from its own rounds (it has none); deltas install directly.
+  if (snapshot_retain_ > 0 && replica_of_ < 0) {
+    const char* q = nullptr;
+    size_t qlen = 0;
+    if (ks->quant_ok &&
+        CachedReplyValid(ks->qreply_round[slot], ks->round[slot],
+                         !ks->qreply[slot].empty())) {
+      q = ks->qreply[slot].data();
+      qlen = ks->qreply[slot].size();
+    }
+    if (snaps_.Publish(ks->tenant, ks->key, ks->round[slot], ks->dtype,
+                       ks->slot[slot].data(), ks->slot[slot].size(), q,
+                       qlen)) {
+      BPS_METRIC_COUNTER_ADD("bps_snap_publish_total", 1);
+      BPS_METRIC_GAUGE_SET("bps_snapshot_version", snaps_.latest());
+    }
   }
   // Release pulls that arrived before the last push — but only this
   // round's; a later round's pulls stay parked. Move the list out
@@ -1338,7 +1704,13 @@ bool BytePSServer::ReplyPull(KeyStore* ks, int slot, const EngineTask& t) {
   // exact mean over the round's roster. (Async replies carry their
   // apply counter in arg1 through their own branch, untouched.)
   resp.arg1 = ks->contrib_n[slot];
-  if (ks->reply_comp && !ks->comp_reply[slot].empty()) {
+  // Cached-encode guards: a cached re-encode is served only when its
+  // round tag matches the round this reply answers for (stale-reply
+  // hazard, ISSUE 16 satellite). Tag mismatch — a re-seeded slot, or a
+  // replay racing a recycle — falls through to the raw slot bytes.
+  if (ks->reply_comp &&
+      CachedReplyValid(ks->comp_reply_round[slot], req.version,
+                       !ks->comp_reply[slot].empty())) {
     resp.flags = FLAG_COMPRESSED;
     resp.arg0 = ks->len;  // decompressed size, for the worker's check
     BPS_METRIC_COUNTER_ADD(
@@ -1348,7 +1720,8 @@ bool BytePSServer::ReplyPull(KeyStore* ks, int slot, const EngineTask& t) {
     SendReply(t, resp, ks->comp_reply[slot].data(),
               ks->comp_reply[slot].size());
   } else if ((req.flags & FLAG_WIRE_QUANT) &&
-             !ks->qreply[slot].empty()) {
+             CachedReplyValid(ks->qreply_round[slot], req.version,
+                              !ks->qreply[slot].empty())) {
     // Quantized reply leg: the round's cached re-quantized aggregate.
     // Serve-by-request — a pull without the flag (or a slot whose
     // cache a re-seed cleared) falls through to the raw bytes below,
@@ -1475,6 +1848,7 @@ void BytePSServer::EncodeQuantReply(KeyStore* ks, int slot) {
 void BytePSServer::Stop() {
   if (queues_.empty()) return;
   stopped_.store(true);
+  if (replica_thread_.joinable()) replica_thread_.join();
   for (auto& eq : queues_) {
     std::lock_guard<std::mutex> lk(eq->mu);
     eq->cv.notify_all();

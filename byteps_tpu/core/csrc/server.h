@@ -42,13 +42,24 @@
 #include "compressor.h"
 #include "elastic.h"
 #include "postoffice.h"
+#include "snapshot.h"
 #include "tenancy.h"
 
 namespace bps {
 
 class BytePSServer {
  public:
-  void Start(Postoffice* po, int engine_threads, bool async_mode);
+  // replica_of >= 0 starts the engine in READ-REPLICA mode (ISSUE 16):
+  // no training data plane — the process serves CMD_SNAP_PULL from a
+  // snapshot store fed by per-round deltas polled off primary server
+  // rank `replica_of` (StartReplicaPoll, called once the postoffice
+  // joined the fleet and holds the address book).
+  void Start(Postoffice* po, int engine_threads, bool async_mode,
+             int replica_of = -1);
+  // Replica only: spawn the delta-poll thread. Separate from Start
+  // because Start runs BEFORE the postoffice forms (engine threads must
+  // exist first) and the poll needs the primary's book entry.
+  void StartReplicaPoll();
   void Handle(Message&& msg, int fd);  // van-thread entry; enqueues to engine
   void Stop();
   ~BytePSServer() { Stop(); }
@@ -111,6 +122,10 @@ class BytePSServer {
     // tenants' colliding tids can never alias; this field is the
     // back-reference for completion counts, rosters, and accounting.
     uint16_t tenant = 0;
+    // Bare wire key, set at INIT_KEY: the snapshot publication hook
+    // (RoundReady) needs the full (tenant, key) identity and only has
+    // the KeyStore in hand.
+    int64_t key = -1;
     // Idempotent-retry dedup window (ISSUE 3): per sender, the last
     // data-plane request seen for this key. Per key per sender at most
     // ONE request chain is outstanding (the worker's per-key ordering
@@ -158,6 +173,14 @@ class BytePSServer {
     // residual into the next round (DoubleSqueeze-style two-way EF).
     std::unique_ptr<Compressor> reply_comp;
     std::vector<char> comp_reply[2];  // cached encode, one per live round
+    // Stale-reply guard (ISSUE 16 satellite): the ROUND each cached
+    // re-encode was produced for, stamped at encode time and asserted
+    // at every serve site (ReplyPull / ServeRetainedPull /
+    // AnswerDuplicate via CachedReplyValid). Before the tag, the
+    // cached bytes were guarded only by round checks on the SLOT — a
+    // dedup-replayed pull racing a slot re-encode could ship a newer
+    // round's bytes under an older round's header. -1 = no valid cache.
+    int comp_reply_round[2] = {-1, -1};
     // Quantized wire (ISSUE 6): true when this key's pushes may arrive
     // block-quantized and its pull replies are re-quantized — quant
     // armed fleet-wide, codec-less, float32, at least the minimum raw
@@ -174,6 +197,7 @@ class BytePSServer {
     // shows the worker-side push EF alone tracks dense (docs/rationale).
     bool quant_ok = false;
     std::vector<char> qreply[2];  // cached quantized encode per slot
+    int qreply_round[2] = {-1, -1};  // round tag (see comp_reply_round)
     // sync mode: double-buffered rounds. round[s] is the full round
     // number (head.version) the slot currently accumulates/serves;
     // pushes/pulls for a LATER round that maps to a busy slot are parked
@@ -248,8 +272,11 @@ class BytePSServer {
   KeyStore* GetStore(uint16_t tenant, int64_t key);
   // Route an engine task to its key's thread through the per-tenant
   // DRR lanes (the one enqueue point: depth/cost accounting lives
-  // here).
-  void EnqueueTask(EngineTask&& task);
+  // here). `lane` overrides the DRR lane the task is queued under
+  // (default: the frame's tenant) — the serving path enqueues reader
+  // traffic under kServingLane without touching the header's tenant,
+  // which the snapshot lookup and the reply stamping still need.
+  void EnqueueTask(EngineTask&& task, int lane = -1);
   // Zero-cost control marker into a specific queue's tenant lane
   // (roster re-eval / rollback tasks).
   void EnqueueTaskTo(EngineQueue& eq, EngineTask&& task);
@@ -279,6 +306,25 @@ class BytePSServer {
   // Encode one round's aggregate into qreply[slot] (quant-eligible keys
   // only; called at round-ready, exactly like the comp_reply encode).
   void EncodeQuantReply(KeyStore* ks, int slot);
+
+  // --- snapshot serving (ISSUE 16) ---
+  // CMD_SNAP_PULL: one reader's request for one key's snapshot —
+  // resolve against the store, echo the served version, reply on the
+  // arrival fd (readers are raw TCP clients, never registered nodes).
+  void ProcessSnapPull(EngineTask& task);
+  // CMD_SNAP_SUB (primary): a replica's delta poll — gather every
+  // committed entry past its watermark (bounded per frame) into one
+  // CMD_SNAP_DELTA (SubHeader table + payloads, the CMD_MULTI layout).
+  void ProcessSnapSub(EngineTask& task);
+  // CMD_SNAP_DELTA (replica): install the batch (idempotent) and adopt
+  // the primary's committed watermark.
+  void ProcessSnapDelta(EngineTask& task);
+  // Replica delta-poll loop: dial the primary, send CMD_SNAP_SUB with
+  // our highest held version every poll interval (a lost SUB or DELTA
+  // is repaired by the next poll — retry semantics without a retry
+  // layer), re-dial on failure from the live address book (so a
+  // hot-replaced primary is picked up).
+  void ReplicaPollLoop();
 
   // The round is complete (every expected contributor summed): seal the
   // contribution roster, encode the cached replies, release this
@@ -364,6 +410,27 @@ class BytePSServer {
   std::vector<std::unique_ptr<EngineQueue>> queues_;
   std::vector<std::thread> threads_;
   std::atomic<bool> stopped_{false};
+
+  // --- snapshot serving (ISSUE 16) ---
+  // The DRR lane reader traffic rides: a reserved lane id no tenant can
+  // collide with (tenants are worker-advertised and the fleet never
+  // registers 0xFFFF), weighted by BYTEPS_SERVING_WEIGHT — so a reader
+  // swarm shares the engine at a capped ratio and provably cannot move
+  // the training digest.
+  static constexpr uint16_t kServingLane = 0xFFFF;
+  SnapStore snaps_;
+  // BYTEPS_SNAPSHOT_RETAIN: per-key retention ring depth; 0 disables
+  // snapshot publication (and with it the whole serving path) on this
+  // node.
+  int snapshot_retain_ = 4;
+  int64_t serving_weight_ = 1;  // BYTEPS_SERVING_WEIGHT
+  // Bound one CMD_SNAP_DELTA frame's raw payload; a lagging replica
+  // catches up over successive polls instead of one giant frame.
+  int64_t snap_delta_max_bytes_ = 16 << 20;
+  // Replica mode: the primary server RANK this process mirrors
+  // (BYTEPS_REPLICA_OF); -1 = a normal training-plane server.
+  int replica_of_ = -1;
+  std::thread replica_thread_;
 };
 
 }  // namespace bps

@@ -144,6 +144,12 @@ def _load() -> ctypes.CDLL:
     lib.bps_sched_probe.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                     ctypes.c_longlong]
     lib.bps_sched_probe.restype = ctypes.c_longlong
+    # Versioned snapshot serving (ISSUE 16): the no-topology SnapStore /
+    # stale-reply-tag probe (publish / commit gating / retention ring /
+    # delta collection / CachedReplyValid).
+    lib.bps_snap_probe.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                   ctypes.c_longlong]
+    lib.bps_snap_probe.restype = ctypes.c_longlong
     _lib = lib
     return lib
 
@@ -239,6 +245,29 @@ def sched_probe(script: str) -> dict:
         need = int(lib.bps_sched_probe(script.encode(), buf, size))
         if need < 0:
             raise ValueError(f"malformed sched probe script {script!r}")
+        if need < size:
+            return json.loads(buf.value.decode())
+        size = need + 1
+
+
+def snap_probe(script: str) -> dict:
+    """Drive the C core's standalone snapshot store (ISSUE 16) through a
+    `;`-separated op script (retain:/publish:/publishq:/force:/pull:/
+    oldest:/collect:/tag:) and return the final state — committed latest,
+    publish/eviction counters, per-pull miss codes and resolved cut
+    versions, delta-collection watermarks, and CachedReplyValid verdicts
+    for the stale-reply-tag fix. The no-fleet unit-test surface for the
+    serving subsystem's consistency arithmetic. Raises ValueError on a
+    malformed script."""
+    import json
+
+    lib = _load()
+    size = 1 << 16
+    while True:
+        buf = ctypes.create_string_buffer(size)
+        need = int(lib.bps_snap_probe(script.encode(), buf, size))
+        if need < 0:
+            raise ValueError(f"malformed snap probe script {script!r}")
         if need < size:
             return json.loads(buf.value.decode())
         size = need + 1
@@ -449,6 +478,16 @@ def _apply_config_env(cfg: Optional[Config]) -> None:
     if cfg.server_engine_pace_mbps > 0:
         os.environ["BYTEPS_SERVER_ENGINE_PACE_MBPS"] = str(
             cfg.server_engine_pace_mbps)
+    # Versioned snapshot serving (ISSUE 16): the primary reads the
+    # retention/weight knobs at engine start, replicas read the poll and
+    # delta-batch knobs. BYTEPS_REPLICA_OF is deliberately NOT projected:
+    # like DMLC_RECOVER_RANK it is per-process identity (which primary
+    # this replica shadows), owned by the supervisor that spawned it.
+    os.environ["BYTEPS_SNAPSHOT_RETAIN"] = str(cfg.snapshot_retain)
+    os.environ["BYTEPS_SERVING_WEIGHT"] = str(cfg.serving_weight)
+    os.environ["BYTEPS_SNAP_DELTA_MAX_BYTES"] = str(
+        cfg.snap_delta_max_bytes)
+    os.environ["BYTEPS_REPLICA_POLL_MS"] = str(cfg.replica_poll_ms)
     os.environ["BYTEPS_CHAOS_SEED"] = str(cfg.chaos_seed)
     os.environ["BYTEPS_CHAOS_DROP"] = str(cfg.chaos_drop)
     os.environ["BYTEPS_CHAOS_DUP"] = str(cfg.chaos_dup)
@@ -557,6 +596,16 @@ class Scheduler(_Node):
 
 class Server(_Node):
     ROLE = 1
+
+
+class Replica(_Node):
+    """Read-only snapshot replica (ISSUE 16): registers with the
+    scheduler like any rostered node, shadows the server rank named by
+    BYTEPS_REPLICA_OF via the snapshot delta protocol, and serves
+    CMD_SNAP_PULL reads (byteps_tpu.client.pull_snapshot). Never joins
+    the training data plane; its death costs readers one failover and
+    trainers nothing."""
+    ROLE = 3
 
 
 class Worker(_Node):

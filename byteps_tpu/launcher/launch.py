@@ -149,6 +149,16 @@ def _reap(procs: List[subprocess.Popen], names: Optional[List[str]] = None,
                     print(f"bpslaunch: {name} (pid {p.pid}) died with "
                           f"{_describe_exit(code)}", file=sys.stderr,
                           flush=True)
+                    if name.startswith("replica") and term_deadline is None:
+                        # Read replicas are expendable by design
+                        # (ISSUE 16): the scheduler scrubs the dead one
+                        # from the roster, readers fail over to the next
+                        # endpoint, and the training fleet never
+                        # notices. Never fail-fast the job for one.
+                        print(f"bpslaunch: {name} was a read replica — "
+                              "readers fail over; fleet continues",
+                              file=sys.stderr, flush=True)
+                        continue
                     if (respawn is not None and term_deadline is None
                             and (name.startswith("server")
                                  or name == "scheduler") and budget > 0):
@@ -219,7 +229,8 @@ def _free_port() -> int:
 def launch_local_fleet(command: Sequence[str], num_workers: int,
                        num_servers: int, port: int, env: Dict[str, str],
                        numa: bool = False, supervise: int = 0,
-                       elastic: bool = False, scale_file: str = "") -> int:
+                       elastic: bool = False, scale_file: str = "",
+                       num_replicas: int = 0) -> int:
     """Bring up scheduler + servers + workers on 127.0.0.1 in one call
     (the reference needs tests/run_byteps_test.sh for this topology).
 
@@ -307,6 +318,28 @@ def launch_local_fleet(command: Sequence[str], num_workers: int,
         prefix = _numa_prefix(idx) if numa else []
         return subprocess.Popen(prefix + list(command), env=e)
 
+    # Versioned snapshot serving (ISSUE 16): read replicas shadow the
+    # servers round-robin. Each gets a PINNED listen port so inference
+    # readers have stable endpoints to fail over across; the combined
+    # list is printed (and exported as BYTEPS_SNAP_ENDPOINTS to the
+    # worker command, spawned below) in byteps_tpu.client.pull_snapshot
+    # format. Spawn order doesn't matter for correctness — the scheduler
+    # buffers replica registrations until fleet formation commits.
+    if num_replicas > 0:
+        snap_eps = []
+        for r in range(num_replicas):
+            rport = _free_port()
+            procs.append(subprocess.Popen(
+                server_cmd,
+                env=_role_env(base, "replica",
+                              BYTEPS_REPLICA_OF=str(r % max(num_servers, 1)),
+                              BYTEPS_LISTEN_PORT=str(rport))))
+            names.append(f"replica{r}")
+            snap_eps.append(f"127.0.0.1:{rport}")
+        base["BYTEPS_SNAP_ENDPOINTS"] = ",".join(snap_eps)
+        print(f"bpslaunch: snapshot endpoints (read replicas): "
+              f"{base['BYTEPS_SNAP_ENDPOINTS']}", file=sys.stderr,
+              flush=True)
     for w in range(num_workers):
         procs.append(_spawn_worker(w, join=False))
         names.append(f"worker{w}")
@@ -410,6 +443,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="servers for --local mode (default 1)")
     p.add_argument("--port", type=int, default=0,
                    help="scheduler port for --local mode (default: free port)")
+    p.add_argument("--replicas", type=int, metavar="N", default=0,
+                   help="--local mode: spawn N read-only snapshot "
+                        "replicas (DMLC_ROLE=replica, docs/serving.md) "
+                        "shadowing the servers round-robin; their pinned "
+                        "reader endpoints are printed and exported to "
+                        "workers as BYTEPS_SNAP_ENDPOINTS for "
+                        "byteps_tpu.client.pull_snapshot. A dead replica "
+                        "costs readers one failover and the fleet "
+                        "nothing (it never fail-fasts the job)")
     p.add_argument("--workers-per-host", type=int,
                    default=int(os.environ.get("BYTEPS_LOCAL_SIZE", "1") or 1),
                    help="worker processes to spawn on this host (TPU default "
@@ -573,7 +615,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                 args.port, dict(os.environ), numa=args.numa,
                                 supervise=args.supervise,
                                 elastic=args.elastic,
-                                scale_file=args.scale_file)
+                                scale_file=args.scale_file,
+                                num_replicas=args.replicas)
         for attempt in range(args.restarts):
             if rc == 0:
                 break
@@ -593,14 +636,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                     numa=args.numa,
                                     supervise=args.supervise,
                                     elastic=args.elastic,
-                                    scale_file=args.scale_file)
+                                    scale_file=args.scale_file,
+                                    num_replicas=args.replicas)
         return rc
 
     role = os.environ.get("DMLC_ROLE", "worker").lower()
-    if role in ("scheduler", "server"):
+    if role in ("scheduler", "server", "replica"):
         return run_server_role(role)
     if role != "worker":
-        p.error(f"DMLC_ROLE must be scheduler|server|worker, got {role!r}")
+        p.error(f"DMLC_ROLE must be scheduler|server|replica|worker, "
+                f"got {role!r}")
     if not command:
         p.error("worker role requires a command")
     procs = spawn_workers(command, args.workers_per_host, dict(os.environ),

@@ -133,6 +133,17 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                         gauges.get("bps_sched_rereg", 0)),
                     "expected": int(
                         gauges.get("bps_sched_rereg_expected", 0)),
+                    # Versioned snapshot serving (ISSUE 16): the
+                    # committed cut this node serves, its lag behind
+                    # the primary (replicas; 0 on a primary), and read
+                    # traffic. -1 snapshot_version = nothing committed
+                    # yet (or serving disabled).
+                    "snapshot_version": int(
+                        gauges.get("bps_snapshot_version", -1)),
+                    "replica_lag_rounds": int(
+                        gauges.get("bps_replica_lag_rounds", 0)),
+                    "snap_pulls": int(
+                        counters.get("bps_snap_pulls_total", 0)),
                     "uptime_s": round(
                         time.monotonic() - self.server.started_at, 3),
                 }).encode()

@@ -25,7 +25,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
-_ROLE_NAMES = {0: "scheduler", 1: "server", 2: "worker"}
+_ROLE_NAMES = {0: "scheduler", 1: "server", 2: "worker", 3: "replica"}
 
 _py_lock = threading.Lock()
 _py_counters: Dict[str, float] = {}

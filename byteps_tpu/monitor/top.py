@@ -34,14 +34,22 @@ DEFAULT_TIMEOUT_S = 2.0
 
 
 def fleet_endpoints(host: str, base_port: int, num_workers: int,
-                    num_servers: int) -> Dict[str, str]:
+                    num_servers: int, num_replicas: int = 0
+                    ) -> Dict[str, str]:
     """role-name -> host:port for every node, from the postoffice id
-    layout (scheduler 0, servers 1..S, workers S+1..S+W)."""
+    layout (scheduler 0, servers 1..S, workers S+1..S+W). Read replicas
+    (ISSUE 16) draw fresh ranks from the same elastic allocator workers
+    use, so a fleet launched with replicas has them at S+W+1..S+W+R —
+    valid as long as no elastic joins raced the replica registrations
+    (use --endpoints for exotic topologies)."""
     eps = {"scheduler": f"{host}:{base_port}"}
     for s in range(num_servers):
         eps[f"server{s}"] = f"{host}:{base_port + 1 + s}"
     for w in range(num_workers):
         eps[f"worker{w}"] = f"{host}:{base_port + 1 + num_servers + w}"
+    for r in range(num_replicas):
+        eps[f"replica{r}"] = (
+            f"{host}:{base_port + 1 + num_servers + num_workers + r}")
     return eps
 
 
@@ -122,6 +130,29 @@ def _tenant_rows(scrapes: Dict[str, Optional[dict]],
     for r in rows.values():
         r["starved"] = (r["queue_depth"] > 0
                         and r["starve_us"] / 1000.0 > starve_ms)
+    return rows
+
+
+def _replica_rows(scrapes: Dict[str, Optional[dict]],
+                  lag_rounds: int = 8) -> Dict[str, dict]:
+    """Per-replica snapshot-serving state (ISSUE 16): the committed
+    snapshot version this replica serves, how many rounds it trails its
+    primary (bps_replica_lag_rounds, stamped from every delta reply),
+    and its read traffic. A replica is REPLICA-LAGGING when the lag
+    exceeds BYTEPS_REPLICA_LAG_ROUNDS — readers pinned there see stale
+    (but still never-torn) cuts."""
+    rows: Dict[str, dict] = {}
+    for name, m in scrapes.items():
+        if not name.startswith("replica") or m is None:
+            continue
+        lag = int(_sample(m, "bps_replica_lag_rounds"))
+        rows[name] = {
+            "snapshot_version": int(_sample(m, "bps_snapshot_version",
+                                            -1)),
+            "lag_rounds": lag,
+            "snap_pulls": int(_sample(m, "bps_snap_pulls_total")),
+            "lagging": lag > lag_rounds,
+        }
     return rows
 
 
@@ -290,9 +321,18 @@ def analyze(scrapes: Dict[str, Optional[dict]],
         scrapes,
         starve_ms=float(_os.environ.get("BYTEPS_TENANT_STARVE_MS",
                                         "2000") or 2000))
+    replicas = _replica_rows(
+        scrapes,
+        lag_rounds=int(_os.environ.get("BYTEPS_REPLICA_LAG_ROUNDS",
+                                       "8") or 8))
 
     return {
         "workers": workers,
+        # Snapshot-serving replicas (ISSUE 16; docs/serving.md).
+        "replicas": replicas,
+        "lagging_replicas": sorted(
+            (n for n, r in replicas.items() if r["lagging"]),
+            key=_rank_key),
         # Multi-tenant rows (ISSUE 9; docs/multitenancy.md).
         "tenants": tenants,
         "starved_tenants": sorted(
@@ -398,8 +438,17 @@ def _print_report(report: dict, as_json: bool) -> None:
               f"{credit:>14} {w.get('retries', 0):>5} "
               f"{w.get('reconnects', 0):>6} {bneck:>14} "
               f"{' '.join(flags)}")
+    replicas = report.get("replicas") or {}
+    if replicas:
+        print(f"{'replica':<10} {'snap-ver':>9} {'lag':>5} "
+              f"{'snap pulls':>10} flags")
+        for name in sorted(replicas, key=_rank_key):
+            r = replicas[name]
+            flags = "REPLICA-LAGGING" if r["lagging"] else ""
+            print(f"{name:<10} {r['snapshot_version']:>9} "
+                  f"{r['lag_rounds']:>5} {r['snap_pulls']:>10} {flags}")
     for kind in ("retrying", "stale_nodes", "dead_nodes", "unreachable",
-                 "starved_tenants"):
+                 "starved_tenants", "lagging_replicas"):
         if report.get(kind):
             print(f"{kind}: {report[kind]}")
 
@@ -418,6 +467,11 @@ def main(argv=None) -> int:
                    default=int(os.environ.get("DMLC_NUM_WORKER", "1")))
     p.add_argument("--num-servers", type=int,
                    default=int(os.environ.get("DMLC_NUM_SERVER", "1")))
+    p.add_argument("--num-replicas", type=int,
+                   default=int(os.environ.get("BYTEPS_NUM_REPLICAS", "0")
+                               or 0),
+                   help="read replicas to scrape (ranks after the "
+                        "workers; docs/serving.md)")
     p.add_argument("--endpoints", nargs="*", metavar="NAME=HOST:PORT",
                    help="explicit endpoints (e.g. worker0=10.0.0.5:9104); "
                         "overrides the derived topology")
@@ -437,7 +491,7 @@ def main(argv=None) -> int:
         eps = dict(e.split("=", 1) for e in args.endpoints)
     else:
         eps = fleet_endpoints(args.host, args.base_port, args.num_workers,
-                              args.num_servers)
+                              args.num_servers, args.num_replicas)
     while True:
         report = analyze({name: scrape(ep) for name, ep in eps.items()},
                          straggler_factor=args.straggler_factor,

@@ -35,13 +35,26 @@ def main() -> None:
         print("byteps_tpu.server: starting as scheduler crash-restart "
               "(DMLC_SCHED_RECOVER) — waiting for the fleet's "
               "re-registration quorum", file=sys.stderr, flush=True)
-    from byteps_tpu.core import Scheduler, Server
+    replica_of = os.environ.get("BYTEPS_REPLICA_OF", "")
+    if role == "replica":
+        # Versioned snapshot serving (ISSUE 16): a read-only replica.
+        # Registers with the scheduler for a fresh elastic rank, shadows
+        # server rank BYTEPS_REPLICA_OF via the snapshot delta protocol,
+        # and serves CMD_SNAP_PULL reads. Its death costs readers one
+        # failover and the training fleet nothing.
+        print(f"byteps_tpu.server: starting as read replica of server "
+              f"rank {replica_of or 0} (snapshot serving)",
+              file=sys.stderr, flush=True)
+    from byteps_tpu.core import Replica, Scheduler, Server
     if role == "scheduler":
         node = Scheduler.start()
     elif role == "server":
         node = Server.start()
+    elif role == "replica":
+        node = Replica.start()
     else:
-        raise SystemExit(f"DMLC_ROLE must be scheduler|server, got {role!r}")
+        raise SystemExit(
+            f"DMLC_ROLE must be scheduler|server|replica, got {role!r}")
     # BYTEPS_MONITOR_ON=1 gave this node a /metrics + /healthz endpoint
     # (byteps_tpu.monitor, started inside Node.start); announce it so
     # operators and monitor.top know where to scrape this role.

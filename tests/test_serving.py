@@ -1,0 +1,295 @@
+"""Versioned snapshot serving acceptance (ISSUE 16).
+
+A real 2w x 2s training fleet (the recovery-mode worker: integer-valued
+float32 aggregates, so everything compares BITWISE) with a swarm of
+`byteps_tpu.client` readers attached. The bars:
+
+ - Consistency: every reader pull is exactly one committed-round cut —
+   all 30 keys in a pinned-version batch decode to the SAME round's
+   aggregate, versions map 1:1 to rounds, and per-reader versions are
+   monotone. Never a torn mix, never stale bytes.
+ - Isolation: the training digest with the reader swarm attached is
+   bit-identical to the no-reader run. Serving is invisible to trainers.
+ - Failover: SIGKILL a read replica mid-run. Readers fail over to the
+   surviving endpoints and keep pulling; trainers finish with the clean
+   digest; the fleet (scheduler, servers, surviving replicas) exits 0.
+   A replica death costs readers one failover and the fleet nothing.
+
+Run the selection alone with `pytest -m serving`.
+"""
+
+import json
+import os
+import threading
+import time
+import traceback
+
+import numpy as np
+import pytest
+
+from tests.ps_utils import (free_port, run_topology, spawn_role,
+                            spawn_worker, topology_env)
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_ps_worker.py")
+
+pytestmark = [pytest.mark.ps, pytest.mark.serving]
+
+# Tight clocks; a paced run (BPS_TEST_ROUND_SLEEP) so readers can sample
+# many cuts while training advances. Retention is deliberately small so
+# the run also proves readers survive ring turnover.
+ROUNDS = 10
+SERVING_ENV = {
+    "PS_HEARTBEAT_INTERVAL": "0.5",
+    "PS_HEARTBEAT_TIMEOUT": "2",
+    "BYTEPS_SNAPSHOT_RETAIN": "6",
+    "BYTEPS_REPLICA_POLL_MS": "50",
+    "BYTEPS_RETRY_TIMEOUT_MS": "300",
+    "BYTEPS_RECONNECT_BACKOFF_MS": "50",
+    "BYTEPS_LOG_LEVEL": "INFO",
+    "BPS_TEST_ROUNDS": str(ROUNDS),
+}
+
+# The recovery-mode worker's tensor layout (tests/_ps_worker.py): 30
+# single-partition tensors, so tensor i lives at key i<<16, and the
+# committed aggregate for round r is (arange(n) % 89 + i + r + 1) * 3
+# (scale = sum of rank+1 over 2 workers). arr[0] therefore names the
+# round: r = arr[0] / 3 - i - 1 — a reader can PROVE which round's cut
+# it got from the bytes alone.
+SIZES = [64, 96, 128, 192, 256, 384, 512, 768, 1024, 1536] * 3
+KEYS = [i << 16 for i in range(30)]
+SCALE = 3
+
+
+def _expected(i, rnd):
+    return ((np.arange(SIZES[i]) % 89 + i + rnd + 1) * SCALE).astype(
+        np.float32)
+
+
+_baseline_cache = {}
+
+
+def _baseline_digest():
+    """Digest of the reader-free 2w x 2s run (cached: it is the
+    isolation oracle — attaching readers must not change one bit)."""
+    if "digest" not in _baseline_cache:
+        extra = dict(SERVING_ENV)
+        extra["BPS_TEST_ROUND_SLEEP"] = "0"
+        outs = run_topology(2, 2, WORKER, mode="recovery", extra=extra,
+                            timeout=180.0)
+        rows = [json.loads(ln) for o in outs for ln in o.splitlines()
+                if ln.startswith("{")]
+        assert len(rows) == 2, outs
+        assert len({r["digest"] for r in rows}) == 1, rows
+        _baseline_cache["digest"] = rows[0]["digest"]
+    return _baseline_cache["digest"]
+
+
+def _wait_for_round(worker, rnd, timeout_s=120.0):
+    deadline = time.time() + timeout_s
+    for line in worker.stdout:
+        if line.startswith(f"round {rnd}"):
+            return
+        if time.time() > deadline:
+            break
+    raise AssertionError(f"worker never reached round {rnd}")
+
+
+class _Reader(threading.Thread):
+    """One inference client hammering pull_snapshot('latest') and
+    verifying every batch is a single-round cut. Stops on its own once
+    it has observed a late-run cut (before fleet teardown can reset its
+    sockets) or when the test signals stop."""
+
+    def __init__(self, endpoints, quant, stop_evt, stop_at_version):
+        super().__init__(daemon=True)
+        self.endpoints = endpoints
+        self.quant = quant
+        self.stop_evt = stop_evt
+        self.stop_at = stop_at_version
+        self.versions = []
+        self.pulls = 0
+        self.failovers = 0
+        self.errors = []
+
+    def run(self):
+        from byteps_tpu.client import SnapshotClient
+        try:
+            with SnapshotClient(endpoints=self.endpoints,
+                                quant=self.quant, timeout=10.0) as c:
+                last = -1
+                while not self.stop_evt.is_set():
+                    version, vals = c.pull(KEYS, version="latest")
+                    rounds = set()
+                    for i, k in enumerate(KEYS):
+                        arr = vals[k]
+                        assert arr.dtype == np.float32, arr.dtype
+                        assert arr.shape == (SIZES[i],), (i, arr.shape)
+                        rnd = int(arr[0]) // SCALE - i - 1
+                        np.testing.assert_array_equal(
+                            arr, _expected(i, rnd),
+                            err_msg=f"key {k:#x} at version {version}")
+                        rounds.add(rnd)
+                    assert len(rounds) == 1, (
+                        f"TORN CUT at version {version}: {sorted(rounds)}")
+                    rnd = rounds.pop()
+                    assert version == rnd, (
+                        f"version {version} served round {rnd}'s bytes")
+                    assert version >= last, (version, last)
+                    last = version
+                    self.versions.append(version)
+                    self.pulls += 1
+                    self.failovers = c.failovers
+                    if version >= self.stop_at:
+                        return
+        except Exception:
+            self.errors.append(traceback.format_exc())
+
+
+def _reap(name, proc, timeout=30, expect_zero=True):
+    out, _ = proc.communicate(timeout=timeout)
+    if expect_zero:
+        assert proc.returncode == 0, f"{name} exited {proc.returncode}:\n{out}"
+    return out
+
+
+def test_serving_consistent_cuts_and_trainer_isolation():
+    """Readers pulling straight from the primaries: every batch is one
+    committed cut, and the training digest is bit-identical to the
+    reader-free run."""
+    baseline = _baseline_digest()
+    port = free_port()
+    env = topology_env(2, 2, port, SERVING_ENV)
+    sports = [free_port(), free_port()]
+    sched = spawn_role("scheduler", env)
+    servers = []
+    for sp in sports:
+        senv = dict(env)
+        senv["BYTEPS_LISTEN_PORT"] = str(sp)
+        servers.append(spawn_role("server", senv))
+    workers = [spawn_worker(WORKER, env, r, "recovery") for r in range(2)]
+    stop = threading.Event()
+    readers = []
+    try:
+        _wait_for_round(workers[0], 1)
+        endpoints = [("127.0.0.1", sp) for sp in sports]
+        # Half the swarm takes the BlockQuant-eligible default, half
+        # opts out to float32 — with the quantized wire off both paths
+        # must serve the exact raw aggregate.
+        readers = [_Reader(endpoints, quant=(n % 2 == 0), stop_evt=stop,
+                           stop_at_version=ROUNDS - 3) for n in range(4)]
+        for rd in readers:
+            rd.start()
+        rows = []
+        for wp in workers:
+            out = _reap("worker", wp, timeout=150)
+            rows += [json.loads(ln) for ln in out.splitlines()
+                     if ln.startswith("{")]
+        stop.set()
+        for rd in readers:
+            rd.join(timeout=30)
+        # Clean fleet exit with readers attached.
+        _reap("server0", servers[0])
+        _reap("server1", servers[1])
+        _reap("scheduler", sched)
+    finally:
+        stop.set()
+        for rd in readers:
+            rd.join(timeout=30)
+        for p in [sched] + servers + workers:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+    for rd in readers:
+        assert not rd.errors, "reader failed:\n" + "\n".join(rd.errors)
+        assert rd.pulls >= 1, "a reader never completed a pull"
+    seen = sorted({v for rd in readers for v in rd.versions})
+    assert len(seen) >= 3, f"readers saw too few distinct cuts: {seen}"
+    # Isolation: the digest is the baseline, bit for bit.
+    assert len(rows) == 2, rows
+    assert {r["digest"] for r in rows} == {baseline}, (rows, baseline)
+
+
+def test_replica_failover_costs_readers_one_hop_and_fleet_nothing():
+    """Three replicas fan out the two shards (rep0,rep2 -> server0,
+    rep1 -> server1). Readers pull ONLY from replicas; rep0 is
+    SIGKILLed mid-run. Readers keep observing consistent cuts via
+    failover, trainers finish bit-identical, the fleet exits clean."""
+    baseline = _baseline_digest()
+    port = free_port()
+    env = topology_env(2, 2, port, SERVING_ENV)
+    sched = spawn_role("scheduler", env)
+    servers = [spawn_role("server", env) for _ in range(2)]
+    rports = [free_port(), free_port(), free_port()]
+    replicas = []
+    for r, (rp, primary) in enumerate(zip(rports, [0, 1, 0])):
+        renv = dict(env)
+        renv["BYTEPS_REPLICA_OF"] = str(primary)
+        renv["BYTEPS_LISTEN_PORT"] = str(rp)
+        replicas.append(spawn_role("replica", renv))
+    workers = [spawn_worker(WORKER, env, r, "recovery") for r in range(2)]
+    stop = threading.Event()
+    readers = []
+    try:
+        _wait_for_round(workers[0], 1)
+        endpoints = [("127.0.0.1", rp) for rp in rports]
+        readers = [_Reader(endpoints, quant=(n % 2 == 0), stop_evt=stop,
+                           stop_at_version=ROUNDS - 3) for n in range(3)]
+        for rd in readers:
+            rd.start()
+        # Let every reader land at least one pre-kill pull (replicas
+        # are caught up and serving), then hard-kill rep0.
+        deadline = time.time() + 60
+        while any(rd.pulls < 1 for rd in readers):
+            assert time.time() < deadline, (
+                f"readers never got going: {[rd.errors for rd in readers]}")
+            assert all(not rd.errors for rd in readers), (
+                [rd.errors for rd in readers])
+            time.sleep(0.05)
+        pre_kill = [rd.pulls for rd in readers]
+        replicas[0].kill()
+        # Readers must make post-kill progress (their endpoint list
+        # still names the corpse; the client rotates past it).
+        deadline = time.time() + 60
+        while any(rd.pulls < pre + 1 and rd.is_alive()
+                  for rd, pre in zip(readers, pre_kill)):
+            assert time.time() < deadline, "no reader progress after kill"
+            assert all(not rd.errors for rd in readers), (
+                [rd.errors for rd in readers])
+            time.sleep(0.05)
+        rows = []
+        for wp in workers:
+            out = _reap("worker", wp, timeout=150)
+            rows += [json.loads(ln) for ln in out.splitlines()
+                     if ln.startswith("{")]
+        stop.set()
+        for rd in readers:
+            rd.join(timeout=30)
+        # Every SURVIVING role exits 0: the replica death never became
+        # a fleet event.
+        _reap("server0", servers[0])
+        _reap("server1", servers[1])
+        _reap("replica1", replicas[1])
+        _reap("replica2", replicas[2])
+        _reap("scheduler", sched)
+    finally:
+        stop.set()
+        for rd in readers:
+            rd.join(timeout=30)
+        for p in [sched] + servers + replicas + workers:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+    for rd in readers:
+        assert not rd.errors, "reader failed:\n" + "\n".join(rd.errors)
+    # The kill cost readers a failover, not correctness: at least one
+    # reader had to rotate off the dead endpoint.
+    assert sum(rd.failovers for rd in readers) >= 1, (
+        [rd.failovers for rd in readers])
+    # The fleet never noticed: trainers bit-identical to the no-reader,
+    # no-replica, no-kill baseline.
+    assert len(rows) == 2, rows
+    assert {r["digest"] for r in rows} == {baseline}, (rows, baseline)
+    assert replicas[0].returncode != 0  # the corpse stays dead

@@ -1,0 +1,296 @@
+"""Versioned snapshot serving — fast tier (ISSUE 16).
+
+Unit-tests the serving subsystem's consistency arithmetic through the
+``bps_snap_probe`` FFI hook (no fleet): snapshot-version monotonicity,
+the two commit-gating rules (all-keys-published fast path + lockstep
+arrival), retention-ring eviction, read resolution (miss codes, idle-key
+cuts), the replica delta collection contract, the CachedReplyValid
+stale-reply-tag predicate (the PR 6 qreply cache fix), and the config
+validation for the new knobs. The end-to-end wire path is covered by
+``pytest -m serving`` (test_serving.py).
+"""
+
+import pytest
+
+from byteps_tpu.config import Config
+
+
+def _probe(script):
+    from byteps_tpu.core.ffi import snap_probe
+    return snap_probe(script)
+
+
+# --- publication & commit gating -------------------------------------------
+
+def test_version_monotone_per_key():
+    # Re-publishing an older or equal version for a key is rejected
+    # outright: snapshot history is append-only (a replayed replica
+    # delta must be an idempotent no-op, never a rewrite).
+    r = _probe("publish:0,7,3;publish:0,7,3;publish:0,7,2;publish:0,7,4")
+    assert r["published"] == [1, 0, 0, 1]
+    assert r["latest"] == 4
+    assert r["publishes"] == 2  # only the installed entries count
+
+
+def test_commit_waits_for_every_key():
+    # Two keys known at v0; v1 with only ONE key published is not a
+    # complete cut, so `latest` must not advance to it.
+    r = _probe("publish:0,1,0;publish:0,2,0;publish:0,1,1")
+    assert r["latest"] == 0
+    # The second key's v1 completes the cut.
+    r = _probe("publish:0,1,0;publish:0,2,0;publish:0,1,1;publish:0,2,1")
+    assert r["latest"] == 1
+
+
+def test_lockstep_arrival_commits_older_versions():
+    # A key that goes idle after one round (a one-shot broadcast) must
+    # not stall commits forever: sync training waits every key's round
+    # v before pushing any v+1, so a publish AT v proves all older
+    # pending versions are complete.
+    r = _probe("publish:0,1,0;publish:0,9,0;"   # both keys at v0
+               "publish:0,1,1;"                 # key 9 idle from here on
+               "publish:0,1,2")
+    assert r["latest"] == 1  # v1 committed by v2's arrival; v2 pending
+    r = _probe("publish:0,1,0;publish:0,9,0;publish:0,1,1;publish:0,1,2;"
+               "publish:0,1,3")
+    assert r["latest"] == 2
+
+
+def test_latest_never_decreases():
+    r = _probe("publish:0,1,5;publish:0,1,6;publish:0,1,2;force:3")
+    assert r["latest"] == 6
+    assert r["published"][-1] == 0  # the v2 straggler was rejected
+
+
+def test_replica_store_never_self_commits():
+    # Replica mode (selfcommit:0): a partially installed delta batch
+    # must not advance `latest` — a reader could otherwise resolve a
+    # cut whose remaining keys are not installed yet (a spurious
+    # UNKNOWN_KEY on a "committed" cut). Only the primary's adopted
+    # watermark (force) commits.
+    r = _probe("selfcommit:0;"
+               "publish:0,1,0;publish:0,2,0;publish:0,1,1;publish:0,2,1;"
+               "pull:0,1,-1")
+    assert r["latest"] == -1
+    assert r["published"] == [1, 1, 1, 1]  # entries install normally
+    assert r["pulls"][0][0] == 2  # NOT_COMMITTED until the watermark
+    r = _probe("selfcommit:0;"
+               "publish:0,1,0;publish:0,2,0;publish:0,1,1;publish:0,2,1;"
+               "force:1;pull:0,2,-1")
+    assert r["latest"] == 1
+    assert r["pulls"][0][:3] == [0, 1, 1]
+
+
+def test_force_latest_is_monotone():
+    # Replica watermark adoption: ForceLatest never moves backwards
+    # (a reordered delta batch must not un-commit a served version).
+    r = _probe("publish:0,1,4;force:10;force:7")
+    assert r["latest"] == 10
+
+
+# --- retention ring ---------------------------------------------------------
+
+def test_retention_ring_evicts_oldest():
+    r = _probe("retain:2;"
+               "publish:0,1,0;publish:0,1,1;publish:0,1,2;publish:0,1,3;"
+               "oldest:0,1;pull:0,1,0;pull:0,1,3")
+    assert r["evictions"] == 2
+    assert r["oldest"] == [2]
+    code, resolved, _val, _q = r["pulls"][0]
+    assert code == 1  # EVICTED: version 0 fell off the ring
+    assert resolved == 0
+    code, resolved, val, _q = r["pulls"][1]
+    assert (code, resolved, val) == (0, 3, 3)
+
+
+def test_retain_floor_is_one():
+    # SetRetain clamps to >= 1: a zero ring would evict the entry being
+    # published (serving-off is a server.cc decision, not a ring size).
+    r = _probe("retain:0;publish:0,1,0;pull:0,1,0")
+    assert r["pulls"][0][0] == 0
+
+
+# --- read resolution --------------------------------------------------------
+
+def test_pull_latest_resolves_and_pins():
+    # version -1 = `latest`; the resolved cut version is echoed so the
+    # client can pin it for the rest of its batch.
+    r = _probe("publish:0,1,0;publish:0,2,0;publish:0,1,1;publish:0,2,1;"
+               "pull:0,1,-1")
+    code, resolved, val, _q = r["pulls"][0]
+    assert (code, resolved, val) == (0, 1, 1)
+
+
+def test_pull_idle_key_serves_newest_at_or_below_cut():
+    # A key idle at the cut version is represented by its last value
+    # before it — a consistent (not torn, not missing) member of the cut.
+    r = _probe("publish:0,1,0;publish:0,9,0;publish:0,1,1;publish:0,1,2;"
+               "pull:0,9,1")
+    code, resolved, val, _q = r["pulls"][0]
+    assert (code, resolved, val) == (0, 1, 0)  # key 9's v0 value, cut 1
+
+
+def test_pull_miss_codes():
+    r = _probe("publish:0,1,0;"
+               "pull:0,1,5;"   # beyond latest -> NOT_COMMITTED
+               "pull:0,99,0;"  # never published -> UNKNOWN_KEY
+               "pull:1,1,0")   # tenant namespacing: wrong tenant
+    assert [p[0] for p in r["pulls"]] == [2, 3, 3]
+
+
+def test_pull_quant_sidecar_presence():
+    # publishq installs a quant serving sidecar; plain publish does not.
+    r = _probe("publishq:0,1,0;publish:0,2,0;pull:0,1,0;pull:0,2,0")
+    assert r["pulls"][0][3] is True
+    assert r["pulls"][1][3] is False
+
+
+def test_nothing_committed_is_not_committed():
+    r = _probe("pull:0,1,-1")
+    assert r["pulls"][0][0] == 2  # NOT_COMMITTED, not a crash
+
+
+# --- replica delta collection ----------------------------------------------
+
+def test_collect_newer_whole_versions_ascending():
+    r = _probe("publish:0,1,0;publish:0,2,0;publish:0,1,1;publish:0,2,1;"
+               "collect:-1,1048576;collect:0,1048576;collect:1,1048576")
+    # Full catch-up: both versions (4 entries), watermark = 1.
+    assert r["collects"][0] == [4, 1]
+    # Incremental: only v1.
+    assert r["collects"][1] == [2, 1]
+    # Nothing newer: empty, watermark unchanged.
+    assert r["collects"][2] == [0, 1]
+
+
+def test_collect_never_leaks_uncommitted_versions():
+    # v1 is only half-published: it must not leave the primary — a
+    # replica adopting it as a watermark would serve a torn cut.
+    r = _probe("publish:0,1,0;publish:0,2,0;publish:0,1,1;"
+               "collect:-1,1048576")
+    assert r["collects"][0] == [2, 0]
+
+
+def test_collect_respects_byte_cap_but_ships_one_version():
+    # The cap bounds a batch, but a pending version must always make
+    # progress (at least one whole version ships even when oversized).
+    r = _probe("publish:0,1,0;publish:0,2,0;publish:0,1,1;publish:0,2,1;"
+               "collect:-1,1")
+    count, through = r["collects"][0]
+    assert count == 2 and through == 0  # one whole version, not both
+
+
+# --- stale-reply tag (the PR 6 qreply cache fix) ----------------------------
+
+def test_cached_reply_tag_predicate():
+    # CachedReplyValid(cached_round, serve_round, nonempty): a cached
+    # re-encode is served ONLY for the exact round it was encoded from.
+    r = _probe("tag:3,3,1;"   # match -> serve the cache
+               "tag:4,3,1;"   # cache outran the request's round -> no
+               "tag:2,3,1;"   # stale cache -> no
+               "tag:-1,3,1;"  # re-seed cleared the tag -> no
+               "tag:3,3,0")   # empty cache -> no, whatever the tag
+    assert r["tags"] == [True, False, False, False, False]
+
+
+def test_probe_rejects_malformed_script():
+    with pytest.raises(ValueError):
+        _probe("publish:oops")
+    with pytest.raises(ValueError):
+        _probe("no_such_op:1")
+
+
+# --- config validation ------------------------------------------------------
+
+def test_config_replica_role_accepted():
+    cfg = Config(role="replica", num_server=2, replica_of=1).validate()
+    assert cfg.replica_of == 1
+
+
+def test_config_replica_of_requires_replica_role():
+    with pytest.raises(ValueError, match="BYTEPS_REPLICA_OF"):
+        Config(role="worker", replica_of=0).validate()
+
+
+def test_config_replica_of_range():
+    with pytest.raises(ValueError, match="out of range"):
+        Config(role="replica", num_server=2, replica_of=2).validate()
+
+
+def test_config_replica_needs_snapshots_and_sync():
+    with pytest.raises(ValueError, match="BYTEPS_SNAPSHOT_RETAIN"):
+        Config(role="replica", snapshot_retain=0).validate()
+    with pytest.raises(ValueError, match="sync-mode"):
+        Config(role="replica", enable_async=True).validate()
+
+
+def test_config_serving_knob_floors():
+    with pytest.raises(ValueError, match="BYTEPS_SNAPSHOT_RETAIN"):
+        Config(snapshot_retain=-1).validate()
+    with pytest.raises(ValueError, match="BYTEPS_SERVING_WEIGHT"):
+        Config(serving_weight=0).validate()
+    with pytest.raises(ValueError, match="BYTEPS_REPLICA_POLL_MS"):
+        Config(replica_poll_ms=5).validate()
+    with pytest.raises(ValueError, match="BYTEPS_SNAP_DELTA_MAX_BYTES"):
+        Config(snap_delta_max_bytes=1024).validate()
+    with pytest.raises(ValueError, match="BYTEPS_REPLICA_LAG_ROUNDS"):
+        Config(replica_lag_rounds=0).validate()
+    # Serving off (retain 0) is a valid non-replica config.
+    Config(snapshot_retain=0).validate()
+
+
+def test_config_load_reads_serving_env(monkeypatch):
+    from byteps_tpu.config import load_config
+    monkeypatch.setenv("BYTEPS_SNAPSHOT_RETAIN", "9")
+    monkeypatch.setenv("BYTEPS_SERVING_WEIGHT", "3")
+    monkeypatch.setenv("DMLC_ROLE", "replica")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "2")
+    monkeypatch.setenv("BYTEPS_REPLICA_OF", "1")
+    cfg = load_config()
+    assert cfg.snapshot_retain == 9
+    assert cfg.serving_weight == 3
+    assert cfg.role == "replica"
+    assert cfg.replica_of == 1
+
+
+# --- the read client's decode path (no fleet) -------------------------------
+
+def test_client_blockquant_decode():
+    # byteps_tpu.client must decode the documented BlockQuant wire
+    # layout: [u16 0xB10C][u16 block][i32 nelem][scales f32][codes i8],
+    # value = code * scale-of-its-block (compressor.cc).
+    import struct
+
+    import numpy as np
+
+    from byteps_tpu.client import decode_block_quant
+
+    block, nelem = 64, 150  # 3 blocks, last one ragged
+    scales = np.array([0.5, 0.25, 2.0], dtype=np.float32)
+    codes = ((np.arange(nelem) % 255) - 127).astype(np.int8)
+    payload = (struct.pack("<HHi", 0xB10C, block, nelem)
+               + scales.tobytes() + codes.tobytes())
+    got = decode_block_quant(payload)
+    want = codes.astype(np.float32) * np.repeat(scales, block)[:nelem]
+    assert got.dtype == np.float32
+    np.testing.assert_array_equal(got, want)
+
+
+def test_client_blockquant_decode_rejects_garbage():
+    import struct
+
+    from byteps_tpu.client import SnapshotError, decode_block_quant
+
+    with pytest.raises(SnapshotError):
+        decode_block_quant(b"\x00" * 16)  # wrong magic
+    with pytest.raises(SnapshotError):
+        # truncated: header promises 64 codes that are not there
+        decode_block_quant(struct.pack("<HHi", 0xB10C, 64, 64) + b"\x00" * 4)
+
+
+def test_client_endpoint_parsing():
+    from byteps_tpu.client import SnapshotClient
+    c = SnapshotClient(endpoints=["10.0.0.5:9200", ("h", 9201)])
+    assert c.endpoints == [("10.0.0.5", 9200), ("h", 9201)]
+    with pytest.raises(ValueError):
+        SnapshotClient(endpoints=["no-port"])

@@ -65,6 +65,7 @@ KNOWN_FAMILIES = frozenset({
     "ps",
     "scaling",
     "sched",        # ISSUE 15: scheduler fail-over park→resume bench
+    "serving",      # ISSUE 16: snapshot read throughput vs replicas
     "shm_van",
     "striping",
     "tenant",       # ISSUE 9: multi-tenant weighted-split bench
