@@ -122,8 +122,9 @@ class SnapshotClient:
     Holds one TCP connection to the current endpoint; any socket error
     rotates to the next endpoint and retries the in-flight pull (reads
     are idempotent, so a retry can only cost duplicate work, never
-    wrong data). One full rotation with every endpoint down raises
-    SnapshotError.
+    wrong data). Each endpoint gets several attempts per pull —
+    transient faults clear on a fresh connection — and only a bounded
+    retry budget exhausted across every endpoint raises SnapshotError.
     """
 
     def __init__(self, endpoints: Optional[Sequence[Endpoint]] = None,
@@ -228,16 +229,29 @@ class SnapshotClient:
         return SNAP_OK, {"version": int(rversion), "array": arr}
 
     def _pull_failover(self, key: int, version: int) -> Tuple[int, dict]:
+        # Reads are idempotent, so errors are cheap to retry — and a
+        # transient fault (a dropped reply timing out, a dup-desynced
+        # stream, a mid-frame reset) clears on a FRESH connection to the
+        # same endpoint, not only on a different endpoint. One shot per
+        # endpoint would turn two transient faults in a row into a hard
+        # failure; instead each endpoint gets several attempts, with a
+        # brief pause after each full rotation. Endpoints that are
+        # genuinely down still fail fast (connect refused), so a dead
+        # fleet costs ~attempts x connect-fail, not attempts x timeout.
         last: Optional[Exception] = None
-        for _ in range(len(self.endpoints)):
+        attempts = max(3 * len(self.endpoints), 6)
+        for attempt in range(1, attempts + 1):
             try:
                 return self._pull_once(key, version)
             except (OSError, ConnectionError) as e:
                 last = e
                 self._rotate()
+                if attempt % len(self.endpoints) == 0 and attempt < attempts:
+                    time.sleep(0.05)
         raise SnapshotError(
-            f"all {len(self.endpoints)} snapshot endpoint(s) failed "
-            f"pulling key {key} (last: {last})")
+            f"snapshot pull of key {key} failed after {attempts} "
+            f"attempt(s) across {len(self.endpoints)} endpoint(s) "
+            f"(last: {last})")
 
     # -- public API -------------------------------------------------------
 

@@ -261,6 +261,34 @@ class Config:
     #   REPLICA-LAGGING when its committed snapshot version trails its
     #   primary's by more than this many rounds
 
+    # --- durable checkpoints (ISSUE 18; docs/checkpoint.md) ----------------
+    ckpt_dir: str = ""                    # BYTEPS_CKPT_DIR
+    #   server-side durable spill directory: each server persists every
+    #   BYTEPS_CKPT_EVERY'th committed snapshot cut as CRC32C-checksummed
+    #   chunk files plus a sealed MANIFEST (tmp -> fsync -> rename), off
+    #   the engine critical path. Empty (default) keeps the server
+    #   byte-for-byte pre-checkpoint — no writer thread, no metrics
+    ckpt_every: int = 1                   # BYTEPS_CKPT_EVERY
+    #   spill cadence: persist every Nth committed snapshot version
+    ckpt_retain: int = 2                  # BYTEPS_CKPT_RETAIN
+    #   durable retention: keep the newest N checkpoint versions per
+    #   shard on disk (older directories are pruned after each spill)
+    ckpt_restore: bool = False            # BYTEPS_CKPT_RESTORE
+    #   server-process only: arm restore — scan BYTEPS_CKPT_DIR for the
+    #   newest checksum-valid manifest at startup and report it at
+    #   registration; the scheduler commits a fleet-wide restore epoch
+    #   at the minimum common version (all servers must be armed, and
+    #   every shard must hold a valid checkpoint — a missing/corrupt
+    #   shard is a clean fail-stop, never a silent cold start)
+    ckpt_lag_warn: int = 8                # BYTEPS_CKPT_LAG_WARN
+    #   monitoring threshold: monitor.top flags a server CKPT-LAGGING
+    #   when its latest committed snapshot version leads its last
+    #   durably spilled version by more than this many rounds
+    chaos_ckpt: str = ""                  # BYTEPS_CHAOS_CKPT
+    #   torn-write injection ("truncate" | "bitflip"): corrupt chunk 0
+    #   of every spill AFTER its CRC is recorded but BEFORE the manifest
+    #   is sealed — the restore scan must reject the version
+
     # --- chaos injection (deterministic fault harness; BYTEPS_CHAOS_*) -----
     chaos_seed: int = 0                   # BYTEPS_CHAOS_SEED
     chaos_drop: float = 0.0               # BYTEPS_CHAOS_DROP
@@ -706,6 +734,43 @@ class Config:
                     "are round-versioned consistent cuts, and async "
                     "mode has no round boundaries to cut at — snapshot "
                     "serving is a sync-mode feature")
+        if self.ckpt_every < 1:
+            raise ValueError(
+                "BYTEPS_CKPT_EVERY must be >= 1 (spill every Nth "
+                "committed snapshot version)")
+        if self.ckpt_retain < 1:
+            raise ValueError(
+                "BYTEPS_CKPT_RETAIN must be >= 1 (durable retention "
+                "below one version would prune the checkpoint being "
+                "written; unset BYTEPS_CKPT_DIR to disable spilling)")
+        if self.ckpt_lag_warn < 1:
+            raise ValueError(
+                "BYTEPS_CKPT_LAG_WARN must be >= 1 (the CKPT-LAGGING "
+                "monitor threshold; a server is always legitimately "
+                "mid-spill one version behind)")
+        if self.ckpt_dir and self.snapshot_retain == 0:
+            raise ValueError(
+                "BYTEPS_CKPT_DIR with BYTEPS_SNAPSHOT_RETAIN=0: the "
+                "durable spill persists committed snapshot cuts, and "
+                "with snapshot publication disabled there is never a "
+                "cut to spill — every checkpoint would be empty")
+        if self.ckpt_restore and not self.ckpt_dir:
+            raise ValueError(
+                "BYTEPS_CKPT_RESTORE=1 requires BYTEPS_CKPT_DIR: "
+                "restore scans the spill directory for the newest "
+                "checksum-valid manifest, and there is no directory "
+                "to scan")
+        if self.chaos_ckpt:
+            if self.chaos_ckpt not in ("truncate", "bitflip"):
+                raise ValueError(
+                    f"BYTEPS_CHAOS_CKPT ({self.chaos_ckpt!r}) must be "
+                    "'truncate' or 'bitflip' (torn-write injection "
+                    "mode applied to chunk 0 of every spill)")
+            if not self.ckpt_dir:
+                raise ValueError(
+                    "BYTEPS_CHAOS_CKPT requires BYTEPS_CKPT_DIR: "
+                    "torn-write injection corrupts checkpoint spills, "
+                    "and there is nothing being spilled")
         if self.heartbeat_interval_s > 0 and \
                 self.heartbeat_timeout_s <= self.heartbeat_interval_s:
             # A timeout at-or-below the interval declares healthy nodes
@@ -813,6 +878,12 @@ def load_config() -> Config:
         tenant_starve_ms=_env_int("BYTEPS_TENANT_STARVE_MS", 2000),
         server_engine_pace_mbps=_env_int("BYTEPS_SERVER_ENGINE_PACE_MBPS",
                                          0),
+        ckpt_dir=_env_str("BYTEPS_CKPT_DIR", ""),
+        ckpt_every=_env_int("BYTEPS_CKPT_EVERY", 1),
+        ckpt_retain=_env_int("BYTEPS_CKPT_RETAIN", 2),
+        ckpt_restore=_env_bool("BYTEPS_CKPT_RESTORE"),
+        ckpt_lag_warn=_env_int("BYTEPS_CKPT_LAG_WARN", 8),
+        chaos_ckpt=_env_str("BYTEPS_CHAOS_CKPT", ""),
         chaos_seed=_env_int("BYTEPS_CHAOS_SEED", 0),
         chaos_drop=float(os.environ.get("BYTEPS_CHAOS_DROP", "0") or 0),
         chaos_dup=float(os.environ.get("BYTEPS_CHAOS_DUP", "0") or 0),

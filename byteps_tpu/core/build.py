@@ -25,6 +25,7 @@ SOURCES = [
     "postoffice.cc",
     "cpu_reducer.cc",
     "compressor.cc",
+    "ckpt.cc",
     "server.cc",
     "worker.cc",
     "c_api.cc",

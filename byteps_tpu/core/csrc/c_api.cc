@@ -4,6 +4,10 @@
 // entry points (byteps_init / byteps_declare_tensor / EnqueueTensor /
 // byteps_rank / ...; SURVEY.md §2.1) — env-var configured exactly like the
 // reference (DMLC_* / BYTEPS_* families, docs/ENV.md).
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <chrono>
 #include <cmath>
 #include <cstdio>
@@ -13,6 +17,7 @@
 #include <string>
 #include <vector>
 
+#include "ckpt.h"
 #include "common.h"
 #include "compressor.h"
 #include "cpu_reducer.h"
@@ -146,6 +151,13 @@ int bps_init(int role) {
     handler = [gl](Message&& m, int fd) {
       gl->server->Handle(std::move(m), fd);
     };
+    // Durable restore (ISSUE 18): the server scanned its checkpoint dir
+    // in Start; arm the postoffice BEFORE registration so the durable
+    // version rides this shard's CMD_REGISTER and the scheduler can
+    // commit the fleet-wide restore epoch.
+    if (gl->server->restore_armed()) {
+      gl->po->SetDurableCkpt(gl->server->durable_ckpt_version());
+    }
     // Elastic worker membership (ISSUE 8): membership epochs land here
     // — a join pushes a new contributor roster, a removal rolls the
     // in-flight rounds back onto the survivors.
@@ -239,6 +251,17 @@ int bps_init(int role) {
   if (gl->role == ROLE_WORKER && EnvBool("DMLC_JOIN")) {
     gl->worker->SyncRounds(gl->po->join_round(),
                            gl->po->join_bcast_round());
+  }
+  // Durable restore epoch (ISSUE 18): the ADDRBOOK carried the round
+  // the fleet resumes from. Workers jump their counters past it so the
+  // first post-restore push is round R+1 — the PR 8 SyncRounds
+  // machinery, driven by a disk-backed epoch instead of a join.
+  if (gl->role == ROLE_WORKER && gl->po->restore_round() >= 0) {
+    gl->worker->SyncRounds(gl->po->restore_round() + 1, 0);
+    BPS_LOG(WARNING) << "worker: resuming from restored checkpoint "
+                        "round " << gl->po->restore_round()
+                     << " — counters jump to "
+                     << gl->po->restore_round() + 1;
   }
   // Fleet tracing (ISSUE 5): identity for this rank's dump metadata,
   // plus the trace-health series pre-registered so every /metrics page
@@ -1239,6 +1262,192 @@ long long bps_snap_probe(const char* script, char* buf,
     buf[n] = '\0';
   }
   return need;
+}
+
+// Fleet-free durable-checkpoint probe (ISSUE 18; modeled on
+// bps_snap_probe): drives the spill / scan / load / torn-rejection
+// matrix against a real directory, no topology. Script DSL
+// (semicolon-separated op:args):
+//   dir:<path>      checkpoint root for all later ops
+//   rank:<r>        shard rank for all later ops
+//   chaos:<mode>    none | truncate | bitflip (applied by later spills)
+//   spill:V,K       spill a synthetic K-key cut as version V; item i is
+//                   16 float32s of value V*1000+i under tenant i%2 —
+//                   deterministic, so load can assert fidelity
+//   retain:N        CkptRetain(dir, rank, N)
+//   scan:0          newest fully-valid version (-1 none)
+//   list:0          all fully-valid versions, ascending
+//   load:V          [ok, round, items, first] — first = item 0's first
+//                   float (0 when the load failed)
+//   tear:V,M        corrupt an EXISTING checkpoint: M=0 truncate the
+//                   manifest to half, 1 truncate chunk_0, 2 bit-flip
+//                   chunk_0 byte 0, 3 delete the manifest
+//   crc:<text>      CRC32C of the literal text (known-vector check)
+// Output: {"spills":[...],"scans":[...],"lists":[[...]],"loads":[...],
+//          "tears":[...],"crcs":[...]}. Returns the JSON length, or -1
+// on a malformed script.
+long long bps_ckpt_probe(const char* script, char* buf, long long maxlen) {
+  if (!script) return -1;
+  std::string dir = ".";
+  int rank = 0;
+  std::string chaos;
+  std::vector<int> spills, tears;
+  std::vector<long long> scans;
+  std::vector<std::string> lists, loads, crcs;
+  const std::string s(script);
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t end = s.find(';', pos);
+    if (end == std::string::npos) end = s.size();
+    const std::string tok = s.substr(pos, end - pos);
+    pos = end + 1;
+    if (tok.empty()) continue;
+    const size_t colon = tok.find(':');
+    if (colon == std::string::npos) return -1;
+    const std::string op = tok.substr(0, colon);
+    const std::string val = tok.substr(colon + 1);
+    if (op == "dir") {
+      dir = val;
+    } else if (op == "rank") {
+      rank = atoi(val.c_str());
+    } else if (op == "chaos") {
+      chaos = val == "none" ? "" : val;
+    } else if (op == "spill") {
+      long long v = 0, k = 0;
+      if (sscanf(val.c_str(), "%lld,%lld", &v, &k) != 2) return -1;
+      std::vector<SnapDeltaEnt> cut;
+      for (long long i = 0; i < k; ++i) {
+        SnapDeltaEnt d;
+        d.tenant = static_cast<uint16_t>(i % 2);
+        d.key = i;
+        d.entry.version = v;
+        d.entry.dtype = BPS_FLOAT32;
+        std::vector<char> raw(16 * sizeof(float));
+        float f = static_cast<float>(v * 1000 + i);
+        for (int j = 0; j < 16; ++j) {
+          memcpy(raw.data() + j * sizeof(float), &f, sizeof(float));
+        }
+        d.entry.raw =
+            std::make_shared<const std::vector<char>>(std::move(raw));
+        cut.push_back(std::move(d));
+      }
+      std::string why;
+      spills.push_back(
+          CkptSpillSync(dir, rank, v, cut, 1, 1, chaos, &why) ? 1 : 0);
+    } else if (op == "retain") {
+      CkptRetain(dir, rank, atoi(val.c_str()));
+    } else if (op == "scan") {
+      std::string why;
+      scans.push_back(CkptScan(dir, rank, &why));
+    } else if (op == "list") {
+      const auto got = CkptList(dir, rank);
+      std::string l = "[";
+      for (size_t i = 0; i < got.size(); ++i) {
+        if (i) l += ",";
+        l += std::to_string(static_cast<long long>(got[i]));
+      }
+      lists.push_back(l + "]");
+    } else if (op == "load") {
+      std::vector<CkptItem> items;
+      int64_t round = -1;
+      std::string why;
+      const bool ok =
+          CkptLoad(dir, rank, atoll(val.c_str()), &items, &round, &why);
+      float first = 0;
+      if (ok && !items.empty() &&
+          items[0].data.size() >= sizeof(float)) {
+        memcpy(&first, items[0].data.data(), sizeof(float));
+      }
+      loads.push_back("[" + std::to_string(ok ? 1 : 0) + "," +
+                      std::to_string(static_cast<long long>(round)) +
+                      "," + std::to_string(items.size()) + "," +
+                      std::to_string(static_cast<long long>(first)) +
+                      "]");
+    } else if (op == "tear") {
+      long long v = 0, mode = 0;
+      if (sscanf(val.c_str(), "%lld,%lld", &v, &mode) != 2) return -1;
+      const std::string base = dir + "/ckpt_v" + std::to_string(v) +
+                               "_s" + std::to_string(rank);
+      const std::string manifest = base + "/MANIFEST";
+      const std::string chunk0 = base + "/chunk_0.bin";
+      const std::string target = mode == 0 || mode == 3 ? manifest
+                                                        : chunk0;
+      int rc = -1;
+      struct stat st{};
+      if (stat(target.c_str(), &st) == 0) {
+        if (mode == 0 || mode == 1) {
+          rc = truncate(target.c_str(), st.st_size / 2);
+        } else if (mode == 2) {
+          int fd = open(target.c_str(), O_RDWR);
+          if (fd >= 0) {
+            char b = 0;
+            if (pread(fd, &b, 1, 0) == 1) {
+              b ^= 0x01;
+              rc = pwrite(fd, &b, 1, 0) == 1 ? 0 : -1;
+            }
+            close(fd);
+          }
+        } else if (mode == 3) {
+          rc = unlink(target.c_str());
+        }
+      }
+      tears.push_back(rc == 0 ? 1 : 0);
+    } else if (op == "crc") {
+      char hex[16];
+      snprintf(hex, sizeof(hex), "%u",
+               Crc32c(val.data(), val.size()));
+      crcs.push_back(hex);
+    } else {
+      return -1;
+    }
+  }
+  auto emit_list = [](std::string* out, const char* name,
+                      const std::vector<std::string>& items) {
+    *out += std::string(",\"") + name + "\":[";
+    for (size_t i = 0; i < items.size(); ++i) {
+      if (i) *out += ",";
+      *out += items[i];
+    }
+    *out += "]";
+  };
+  std::string out = "{\"spills\":[";
+  for (size_t i = 0; i < spills.size(); ++i) {
+    if (i) out += ",";
+    out += std::to_string(spills[i]);
+  }
+  out += "]";
+  out += ",\"scans\":[";
+  for (size_t i = 0; i < scans.size(); ++i) {
+    if (i) out += ",";
+    out += std::to_string(scans[i]);
+  }
+  out += "]";
+  emit_list(&out, "lists", lists);
+  emit_list(&out, "loads", loads);
+  out += ",\"tears\":[";
+  for (size_t i = 0; i < tears.size(); ++i) {
+    if (i) out += ",";
+    out += std::to_string(tears[i]);
+  }
+  out += "]";
+  emit_list(&out, "crcs", crcs);
+  out += "}";
+  const long long need = static_cast<long long>(out.size());
+  if (buf && maxlen > 0) {
+    long long n = need < maxlen - 1 ? need : maxlen - 1;
+    memcpy(buf, out.data(), static_cast<size_t>(n));
+    buf[n] = '\0';
+  }
+  return need;
+}
+
+// The fleet-committed restore epoch this node learned from the address
+// book (-1 = none). Workers use it to label results; tests assert the
+// whole fleet agreed on one epoch.
+long long bps_restore_round() {
+  Global* gl = g();
+  if (!gl->inited || !gl->po) return -1;
+  return gl->po->restore_round();
 }
 
 // Record into the registry from outside the C core: kind is "counter"

@@ -252,6 +252,15 @@ enum MsgFlags : int32_t {
                              // decoded byte length). Mutually exclusive
                              // with FLAG_COMPRESSED — quantization only
                              // applies to codec-less float32 keys.
+  FLAG_CKPT_DURABLE = 1 << 3,  // CMD_REGISTER from a server launched
+                             // with BYTEPS_CKPT_RESTORE=1 (ISSUE 18):
+                             // the header's key field carries
+                             // 1 + newest durable checkpoint version
+                             // (0 = restore armed but no valid
+                             // checkpoint on disk — the scheduler
+                             // fail-stops rather than cold-start). The
+                             // committed fleet restore epoch rides back
+                             // the same way in CMD_ADDRBOOK's key.
 };
 
 // --- wire header ------------------------------------------------------------

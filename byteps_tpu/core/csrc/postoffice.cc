@@ -328,6 +328,20 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
       BPS_LOG(WARNING) << "server: registering as hot replacement for "
                           "server rank " << h.arg0;
     }
+    // Durable-checkpoint restore (ISSUE 18): a restore-armed server
+    // reports its newest checksum-valid checkpoint version so the
+    // scheduler can commit a fleet-wide restore epoch at the minimum
+    // common version across shards. key = 1 + version; 0 = armed with
+    // NOTHING valid on disk (the scheduler fail-stops on it rather
+    // than silently cold-starting one shard).
+    if (role == ROLE_SERVER && durable_armed_) {
+      h.flags |= FLAG_CKPT_DURABLE;
+      h.key = 1 + durable_ckpt_;
+      BPS_LOG(WARNING) << "server: registering restore-armed "
+                          "(BYTEPS_CKPT_RESTORE) — newest durable "
+                          "checkpoint version "
+                       << durable_ckpt_;
+    }
     // Elastic joiner (ISSUE 8): DMLC_JOIN marks a worker joining a
     // RUNNING fleet. The scheduler allocates a fresh never-reused rank,
     // gates the fleet's new rounds, and answers with a direct ADDRBOOK
@@ -554,6 +568,14 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
   return my_id_;
 }
 
+int64_t Postoffice::WaitRestoreRound() {
+  // Blocks until the address book (and with it the scheduler's restore
+  // decision) has arrived; -1 = no restore epoch, ordinary cold start.
+  std::unique_lock<std::mutex> lk(mu_);
+  cv_.wait(lk, [this] { return addrbook_ready_; });
+  return restore_round_.load();
+}
+
 void Postoffice::ControlHandler(Message&& msg, int fd) {
   switch (msg.head.cmd) {
     case CMD_REGISTER: {
@@ -590,6 +612,11 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
         pr.fd = fd;
         memcpy(&pr.info, msg.payload.data(), sizeof(NodeInfo));
         pr.info.id = static_cast<int32_t>(msg.head.arg0);  // preferred rank
+        // Durable restore report (ISSUE 18): key = 1 + newest
+        // checksum-valid checkpoint version; 0 = armed, nothing valid.
+        if (msg.head.flags & FLAG_CKPT_DURABLE) {
+          pr.durable = msg.head.key - 1;
+        }
         pending_regs_.push_back(pr);
         if (static_cast<int>(pending_regs_.size()) ==
             num_workers_ + num_servers_) {
@@ -617,11 +644,56 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
             // Membership event for the scheduler's timeline row.
             Trace::Get().Instant("register", id, id, -1, pr2.info.role);
           }
+          // Durable restore epoch (ISSUE 18): if ANY server registered
+          // restore-armed, ALL must have — a partial restore would
+          // silently cold-start the unarmed shards and diverge the
+          // model. The fleet resumes at the minimum version common to
+          // every shard; a shard with nothing valid on disk makes the
+          // whole restore impossible, so that is a clean fail-stop with
+          // a named diagnostic, never a silent cold start.
+          int64_t restore = -1;
+          {
+            int armed = 0, nsrv = 0;
+            int64_t minv = -1;
+            std::string missing;
+            for (const auto& pr2 : pending_regs_) {
+              if (pr2.info.role != ROLE_SERVER) continue;
+              ++nsrv;
+              if (pr2.durable == -2) continue;  // not restore-armed
+              ++armed;
+              if (pr2.durable < 0) {
+                missing += " server id " + std::to_string(pr2.info.id) +
+                           ";";
+              } else if (minv < 0 || pr2.durable < minv) {
+                minv = pr2.durable;
+              }
+            }
+            if (armed > 0) {
+              BPS_CHECK_EQ(armed, nsrv)
+                  << "ckpt-restore: only " << armed << " of " << nsrv
+                  << " server shard(s) registered restore-armed "
+                     "(BYTEPS_CKPT_RESTORE=1) — restoring a subset "
+                     "would silently cold-start the rest; arm every "
+                     "server or none";
+              BPS_CHECK(missing.empty())
+                  << "ckpt-restore: no checksum-valid checkpoint found "
+                     "on" << missing
+                  << " — refusing a silent cold start (unset "
+                     "BYTEPS_CKPT_RESTORE to start fresh)";
+              restore = minv;
+              restore_round_.store(restore);
+              BPS_LOG(WARNING)
+                  << "scheduler: restore epoch committed at checkpoint "
+                     "version " << restore
+                  << " (minimum common across " << nsrv << " shard(s))";
+            }
+          }
           for (auto& pr2 : pending_regs_) {
             MsgHeader h{};
             h.cmd = CMD_ADDRBOOK;
             h.sender = kSchedulerId;
             h.arg0 = pr2.info.id;  // your assigned id
+            h.key = 1 + restore;   // restore epoch; 0 = none
             van_->Send(pr2.fd, h, nodes_.data(),
                        nodes_.size() * sizeof(NodeInfo));
           }
@@ -694,6 +766,9 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
         join_round_.store(msg.head.arg1 >> 32);
         join_bcast_.store(msg.head.arg1 & 0xffffffff);
       }
+      // Durable restore epoch (ISSUE 18): 1 + checkpoint version the
+      // fleet resumes from; 0 = ordinary cold start.
+      if (msg.head.key > 0) restore_round_.store(msg.head.key - 1);
       addrbook_ready_ = true;
       cv_.notify_all();
       break;

@@ -148,6 +148,25 @@ class Postoffice {
   int64_t join_round() const { return join_round_.load(); }
   int64_t join_bcast_round() const { return join_bcast_.load(); }
 
+  // Durable checkpoints (ISSUE 18). A restore-armed server reports its
+  // newest checksum-valid checkpoint version before Start (set by the
+  // c_api glue from the server's scan; -1 = armed but nothing valid on
+  // disk — the scheduler fail-stops on it by contract). The scheduler
+  // commits a fleet-wide restore epoch at the minimum common version
+  // across all server shards and broadcasts it in CMD_ADDRBOOK; every
+  // node reads it back here (-1 = no restore this formation). Workers
+  // jump their round counters to restore_round()+1; servers install
+  // the checkpoint cut at exactly restore_round().
+  void SetDurableCkpt(int64_t newest) {
+    durable_armed_ = true;
+    durable_ckpt_ = newest;
+  }
+  int64_t restore_round() const { return restore_round_.load(); }
+  // Engine threads may race a fast worker's INIT_KEY against our own
+  // ADDRBOOK receipt: block until the book (and with it the committed
+  // restore epoch) arrived.
+  int64_t WaitRestoreRound();
+
   // Current membership epoch (bumped by the scheduler per recovery) and
   // whether any rank is mid-recovery from this node's point of view.
   int64_t epoch() const { return epoch_.load(); }
@@ -313,7 +332,10 @@ class Postoffice {
   bool addrbook_ready_ = false;
 
   // scheduler state
-  struct PendingReg { int fd; NodeInfo info; };
+  // durable = the registrant's reported newest checkpoint version
+  // (ISSUE 18): -2 = not restore-armed, -1 = armed with nothing valid
+  // on disk, >= 0 = a checksum-valid checkpoint at that version.
+  struct PendingReg { int fd; NodeInfo info; int64_t durable = -2; };
   std::vector<PendingReg> pending_regs_;
   // Read replicas that registered before fleet formation completed
   // (ISSUE 16): parked until there is an address book to answer with.
@@ -380,6 +402,13 @@ class Postoffice {
   // graceful-leave handshake state.
   std::atomic<int64_t> join_round_{0};
   std::atomic<int64_t> join_bcast_{0};
+
+  // Durable checkpoints (ISSUE 18): this node's own report (server,
+  // set before Start) and the fleet's committed restore epoch (every
+  // node, parsed from CMD_ADDRBOOK's key; -1 = none).
+  bool durable_armed_ = false;
+  int64_t durable_ckpt_ = -2;
+  std::atomic<int64_t> restore_round_{-1};
   bool leave_acked_ = false;           // guarded by mu_
   std::atomic<bool> left_{false};      // leave committed: no goodbye owed
 

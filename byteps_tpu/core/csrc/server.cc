@@ -154,6 +154,61 @@ void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode,
   Metrics::Get().Gauge("bps_snapshot_version");
   Metrics::Get().Gauge("bps_replica_lag_rounds");
   BPS_METRIC_GAUGE_SET("bps_snapshot_version", -1);
+  // Durable checkpoints (ISSUE 18): spill/restore config. With
+  // BYTEPS_CKPT_DIR unset this whole block is inert — no writer thread,
+  // no metric series, no disk scan — keeping the server byte-for-byte
+  // the pre-checkpoint build.
+  if (const char* cd = getenv("BYTEPS_CKPT_DIR")) ckpt_dir_ = cd;
+  if (!ckpt_dir_.empty() && replica_of_ < 0) {
+    BPS_CHECK_GT(snapshot_retain_, 0)
+        << "ckpt: BYTEPS_CKPT_DIR set with BYTEPS_SNAPSHOT_RETAIN=0 — "
+           "checkpoints spill the snapshot store's committed cuts; arm "
+           "snapshots or unset the checkpoint dir";
+    if (const char* v = getenv("BYTEPS_CKPT_EVERY")) {
+      ckpt_every_ = std::max(1, atoi(v));
+    }
+    if (const char* v = getenv("BYTEPS_CKPT_RETAIN")) {
+      ckpt_retain_ = std::max(1, atoi(v));
+    }
+    if (const char* v = getenv("BYTEPS_CHAOS_CKPT")) ckpt_chaos_ = v;
+    if (!ckpt_chaos_.empty()) {
+      BPS_CHECK(ckpt_chaos_ == "truncate" || ckpt_chaos_ == "bitflip")
+          << "BYTEPS_CHAOS_CKPT must be 'truncate' or 'bitflip', got '"
+          << ckpt_chaos_ << "'";
+      BPS_LOG(WARNING) << "server: CHAOS torn-write injection armed ("
+                       << ckpt_chaos_
+                       << ") — every spill is corrupted pre-manifest";
+    }
+    if (const char* v = getenv("BYTEPS_CKPT_RESTORE")) {
+      restore_armed_ = atoi(v) != 0;
+    }
+    if (restore_armed_) {
+      // The shard rank must be pinned: restore maps on-disk shard
+      // directories to server ranks, and an unpinned formation could
+      // hand this process a different rank than the one that spilled.
+      const char* wid = getenv("DMLC_WORKER_ID");
+      BPS_CHECK(wid && *wid)
+          << "ckpt-restore: BYTEPS_CKPT_RESTORE=1 requires "
+             "DMLC_WORKER_ID to pin this server's shard rank";
+      std::string why;
+      durable_version_ = CkptScan(ckpt_dir_, atoi(wid), &why);
+      if (!why.empty()) {
+        BPS_LOG(WARNING) << "ckpt-restore: skipped candidate(s):" << why;
+      }
+      BPS_LOG(WARNING) << "server: restore armed — newest durable "
+                          "checkpoint version "
+                       << durable_version_ << " (rank " << wid << ", dir "
+                       << ckpt_dir_ << ")";
+    }
+    // Ckpt series registered ONLY when checkpointing is armed: an
+    // unarmed server's /metrics page is byte-for-byte pre-checkpoint.
+    Metrics::Get().Counter("bps_ckpt_spills_total");
+    Metrics::Get().Counter("bps_ckpt_failures_total");
+    Metrics::Get().Gauge("bps_ckpt_version");
+    Metrics::Get().Gauge("bps_ckpt_lag_rounds");
+    Metrics::Get().Gauge("bps_ckpt_spill_ms");
+    BPS_METRIC_GAUGE_SET("bps_ckpt_version", -1);
+  }
   queues_.clear();
   // DRR weights resolve through the address book at grant time (ISSUE
   // 9): a tenant's BYTEPS_TENANT_WEIGHT rides its workers' NodeInfo
@@ -892,6 +947,11 @@ void BytePSServer::Process(EngineTask&& task) {
           BPS_CHECK_EQ(ks->len, h.arg0) << "key re-declared with new length";
         }
       }
+      // Durable restore (ISSUE 18): install this key's checkpointed
+      // aggregate BEFORE the INIT_ACK releases the worker — by the time
+      // the worker can pull, the restored state is in the slot and in
+      // the snapshot store at the restore round.
+      if (restore_armed_) MaybeInstallRestored(GetStore(h.tenant, h.key));
       MsgHeader ack{};
       ack.cmd = CMD_INIT_ACK;
       ack.sender = po_->my_id();
@@ -1147,58 +1207,8 @@ void BytePSServer::Process(EngineTask&& task) {
       KeyStore* ks = GetStore(h.tenant, h.key);
       BPS_CHECK(ks) << "reseed for undeclared key " << h.key;
       Trace::Get().Note("RESEED", h.key, h.sender, h.req_id, h.version);
-      int slot = h.version & 1;
-      const int ver = static_cast<int>(h.version);
-      // Install only when the slot is not owned by a LATER round. A
-      // chaos-dropped reseed offer re-delivered by the retry timer can
-      // land after the fleet advanced to round ver+2 on the same slot
-      // parity (last_round[slot] is still -1 on a fresh replacement
-      // because round ver completed on the dead predecessor); assigning
-      // over that partial ver+2 sum would complete the round with a
-      // silently corrupted aggregate. A stale offer carries nothing the
-      // fleet still needs — per-key chaining means no worker can be
-      // parked on round ver once ver+2 pushes exist — so just ack it.
-      const bool slot_owned_by_newer =
-          ks->push_count[slot] > 0 && ks->round[slot] != ver;
-      if (ver > ks->last_round[slot] && ks->round[slot] <= ver &&
-          !slot_owned_by_newer) {
-        ks->slot[slot].assign(msg.payload.begin(), msg.payload.end());
-        ks->last_round[slot] = h.version;
-        // The reseed IS a completed round's sum over the then-full
-        // fleet: its mean divisor is the current worker count.
-        ks->last_contrib_n[slot] = TenantWorkerCount(ks->tenant);
-        // The slot may already be accumulating this round from
-        // recovery re-pushes that arrived first; the reseed IS that
-        // round's final sum — supersede the partial accumulation.
-        if (ks->round[slot] == ver) {
-          ks->round[slot] = -1;
-          ks->push_count[slot] = 0;
-          ks->pull_count[slot] = 0;
-          ks->ready[slot] = false;
-          if (elastic_) ks->er[slot].Reset();
-        }
-        ks->comp_reply[slot].clear();
-        ks->comp_reply_round[slot] = -1;
-        // The quantized-reply cache is stale too: a re-seeded slot
-        // serves the authoritative float32 bytes raw (the reseed IS
-        // what the fault-free workers decoded — see ServeRetainedPull).
-        // Tags go to -1 with the bytes: "cleared by re-seed" is the one
-        // mismatch the serve sites answer with raw instead of a
-        // replay-window error.
-        ks->qreply[slot].clear();
-        ks->qreply_round[slot] = -1;
-        // Pulls for this round parked before the reseed landed are
-        // servable now.
-        std::vector<EngineTask> waiting;
-        waiting.swap(ks->pending_pulls[slot]);
-        for (auto& p : waiting) {
-          if (p.msg.head.version == ver) {
-            ServeRetainedPull(ks, slot, p);
-          } else {
-            ks->pending_pulls[slot].push_back(std::move(p));
-          }
-        }
-      }
+      InstallAggregate(ks, h.version, msg.payload.data(),
+                       msg.payload.size(), "reseed");
       MsgHeader ack{};
       ack.cmd = CMD_PUSH_ACK;
       ack.sender = po_->my_id();
@@ -1668,6 +1678,10 @@ void BytePSServer::RoundReady(KeyStore* ks, int slot) {
                        qlen)) {
       BPS_METRIC_COUNTER_ADD("bps_snap_publish_total", 1);
       BPS_METRIC_GAUGE_SET("bps_snapshot_version", snaps_.latest());
+      // Durable spill (ISSUE 18): if the committed version just crossed
+      // a spill boundary, hand the cut to the async writer. Engine-side
+      // cost is pointer work only (shared_ptr cut + queue push).
+      if (!ckpt_dir_.empty()) MaybeSpillCkpt();
     }
   }
   // Release pulls that arrived before the last push — but only this
@@ -1845,9 +1859,161 @@ void BytePSServer::EncodeQuantReply(KeyStore* ks, int slot) {
          "dequant-sum accepted";
 }
 
+void BytePSServer::InstallAggregate(KeyStore* ks, int64_t version,
+                                    const char* data, size_t len,
+                                    const char* why) {
+  const int ver = static_cast<int>(version);
+  const int slot = ver & 1;
+  // Install only when the slot is not owned by a LATER round. A
+  // chaos-dropped reseed offer re-delivered by the retry timer can
+  // land after the fleet advanced to round ver+2 on the same slot
+  // parity (last_round[slot] is still -1 on a fresh replacement
+  // because round ver completed on the dead predecessor); assigning
+  // over that partial ver+2 sum would complete the round with a
+  // silently corrupted aggregate. A stale offer carries nothing the
+  // fleet still needs — per-key chaining means no worker can be
+  // parked on round ver once ver+2 pushes exist — so skip it.
+  const bool slot_owned_by_newer =
+      ks->push_count[slot] > 0 && ks->round[slot] != ver;
+  if (!(ver > ks->last_round[slot] && ks->round[slot] <= ver &&
+        !slot_owned_by_newer)) {
+    BPS_LOG(INFO) << "install (" << why << ") skipped for key " << ks->key
+                  << " round " << ver << " — slot serves round "
+                  << ks->last_round[slot] << "/accumulates "
+                  << ks->round[slot];
+    return;
+  }
+  ks->slot[slot].assign(data, data + len);
+  ks->last_round[slot] = ver;
+  // The installed bytes ARE a completed round's sum over the then-full
+  // fleet: its mean divisor is the current worker count.
+  ks->last_contrib_n[slot] = TenantWorkerCount(ks->tenant);
+  // The slot may already be accumulating this round from recovery
+  // re-pushes that arrived first; the install IS that round's final
+  // sum — supersede the partial accumulation.
+  if (ks->round[slot] == ver) {
+    ks->round[slot] = -1;
+    ks->push_count[slot] = 0;
+    ks->pull_count[slot] = 0;
+    ks->ready[slot] = false;
+    if (elastic_) ks->er[slot].Reset();
+  }
+  ks->comp_reply[slot].clear();
+  ks->comp_reply_round[slot] = -1;
+  // The quantized-reply cache is stale too: an installed slot serves
+  // the authoritative float32 bytes raw (exactly what the fault-free
+  // workers decoded — see ServeRetainedPull). Tags go to -1 with the
+  // bytes: "cleared by install" is the one mismatch the serve sites
+  // answer with raw instead of a replay-window error.
+  ks->qreply[slot].clear();
+  ks->qreply_round[slot] = -1;
+  // Pulls for this round parked before the install landed are
+  // servable now.
+  std::vector<EngineTask> waiting;
+  waiting.swap(ks->pending_pulls[slot]);
+  for (auto& p : waiting) {
+    if (p.msg.head.version == ver) {
+      ServeRetainedPull(ks, slot, p);
+    } else {
+      ks->pending_pulls[slot].push_back(std::move(p));
+    }
+  }
+}
+
+void BytePSServer::MaybeInstallRestored(KeyStore* ks) {
+  // One-shot disk load, deferred to the FIRST declared key: the
+  // fleet-committed restore epoch only exists once the address book
+  // arrived, and an INIT_KEY is proof formation finished — so the
+  // WaitRestoreRound below can never block formation itself.
+  std::call_once(restore_once_, [this] {
+    const int64_t epoch = po_->WaitRestoreRound();
+    BPS_CHECK_GE(epoch, 0)
+        << "ckpt-restore: this server is restore-armed but the "
+           "scheduler committed no restore epoch — mixed arming "
+           "fail-stops at formation, so this is a protocol bug";
+    std::vector<CkptItem> items;
+    int64_t round = -1;
+    std::string why;
+    const int rank = po_->my_id() - 1;
+    BPS_CHECK(CkptLoad(ckpt_dir_, rank, epoch, &items, &round, &why))
+        << "ckpt-restore: shard rank " << rank
+        << " cannot load the fleet-committed restore epoch " << epoch
+        << ": " << why
+        << " — fail-stop (installing less would silently cold-start "
+           "this shard and diverge the model)";
+    std::lock_guard<std::mutex> lk(restore_mu_);
+    ckpt_restore_round_ = epoch;
+    for (auto& it : items) {
+      restored_[{it.tenant, it.key}] = std::move(it);
+    }
+    BPS_LOG(WARNING) << "server: loaded " << restored_.size()
+                     << " key(s) from checkpoint version " << epoch
+                     << " — installing as keys re-declare";
+  });
+  CkptItem item;
+  {
+    std::lock_guard<std::mutex> lk(restore_mu_);
+    auto it = restored_.find({ks->tenant, ks->key});
+    if (it == restored_.end()) return;  // not in the checkpoint (new key)
+    item = std::move(it->second);
+    restored_.erase(it);
+  }
+  BPS_CHECK_EQ(static_cast<int64_t>(item.data.size()), ks->len)
+      << "ckpt-restore: key " << ks->key << " declared with length "
+      << ks->len << " but the checkpoint holds "
+      << item.data.size() << " bytes — the model changed shape; "
+         "fail-stop instead of installing garbage";
+  // Install at the RESTORE round (not the entry's own version — an
+  // idle key's entry may be older): the whole fleet resumes from one
+  // round, and the worker's first post-resume pull is for it.
+  InstallAggregate(ks, ckpt_restore_round_, item.data.data(),
+                   item.data.size(), "ckpt-restore");
+  // Publish into the snapshot store at the restore round: commit
+  // gating makes version R `latest` once the last key installs, and
+  // the workers' state pull (plus external readers) resume from R.
+  if (snapshot_retain_ > 0) {
+    if (snaps_.Publish(item.tenant, item.key, ckpt_restore_round_,
+                       item.dtype, item.data.data(), item.data.size())) {
+      BPS_METRIC_COUNTER_ADD("bps_snap_publish_total", 1);
+      BPS_METRIC_GAUGE_SET("bps_snapshot_version", snaps_.latest());
+    }
+  }
+}
+
+void BytePSServer::MaybeSpillCkpt() {
+  // Lazy writer start: the shard rank is only known post-formation,
+  // and RoundReady proves the book arrived. Engine threads race this;
+  // Start's CAS keeps exactly one winner.
+  if (!ckpt_writer_.running()) {
+    ckpt_writer_.Start(ckpt_dir_, po_->my_id() - 1, ckpt_every_,
+                       ckpt_retain_, ckpt_chaos_, po_->num_workers(),
+                       po_->num_servers());
+  }
+  const int64_t latest = snaps_.latest();
+  if (latest < 0) return;
+  if (ckpt_writer_.ShouldSpill(latest)) {
+    bool complete = false;
+    auto cut = snaps_.CollectCut(latest, &complete);
+    // A committed version is complete by construction; an incomplete
+    // cut here means the ring already evicted part of it (a spill
+    // boundary far behind latest) — skip rather than persist a torn
+    // checkpoint.
+    if (complete) {
+      ckpt_writer_.Enqueue(latest, std::move(cut));
+    } else {
+      BPS_LOG(WARNING) << "ckpt: skipping spill of version " << latest
+                       << " — cut no longer complete in the ring";
+    }
+  }
+  BPS_METRIC_GAUGE_SET(
+      "bps_ckpt_lag_rounds",
+      latest - std::max<int64_t>(0, ckpt_writer_.last_spilled()));
+}
+
 void BytePSServer::Stop() {
   if (queues_.empty()) return;
   stopped_.store(true);
+  ckpt_writer_.Stop();
   if (replica_thread_.joinable()) replica_thread_.join();
   for (auto& eq : queues_) {
     std::lock_guard<std::mutex> lk(eq->mu);

@@ -38,6 +38,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "ckpt.h"
 #include "common.h"
 #include "compressor.h"
 #include "elastic.h"
@@ -78,6 +79,14 @@ class BytePSServer {
   // and the re-eval/rollback tasks visit only that tenant's keys.
   void OnFleetResize(int kind, int affected, int64_t join_round,
                      int64_t join_bcast, int tenant);
+
+  // Durable restore (ISSUE 18): newest checksum-valid checkpoint
+  // version found on disk at Start, -1 when armed but nothing valid,
+  // -2 when BYTEPS_CKPT_RESTORE is not armed. The c_api glue forwards
+  // this to the postoffice BEFORE registration so the report rides the
+  // CMD_REGISTER frame.
+  int64_t durable_ckpt_version() const { return durable_version_; }
+  bool restore_armed() const { return restore_armed_; }
 
  private:
   // Accumulator for one fused frame's batched reply. subs/data are
@@ -326,6 +335,27 @@ class BytePSServer {
   // hot-replaced primary is picked up).
   void ReplicaPollLoop();
 
+  // --- durable checkpoints (ISSUE 18) ---
+  // Install a finished aggregate for round `ver` into the KeyStore's
+  // parity slot: the shared re-seed/restore machinery (slot bytes,
+  // last_round / last_contrib_n, cached-encode invalidation, partial
+  // supersede, parked-pull release). Factored from CMD_RESEED so the
+  // checkpoint restore path installs through the identical invariants.
+  // `why` names the installer in the skip diagnostics. Engine thread
+  // (the key's owner) only.
+  void InstallAggregate(KeyStore* ks, int64_t ver, const char* data,
+                        size_t len, const char* why);
+  // Restore hook (CMD_INIT_KEY): on the first declared key, load the
+  // fleet-committed restore epoch's checkpoint from disk (fail-stop on
+  // any mismatch — never a silent cold start); then install this key's
+  // restored aggregate and publish it into the snapshot store at the
+  // restore round.
+  void MaybeInstallRestored(KeyStore* ks);
+  // Spill trigger (RoundReady, after snapshot Publish): when the
+  // committed snapshot version advanced to a spill boundary, collect
+  // the cut (shared_ptr, no copy) and hand it to the async writer.
+  void MaybeSpillCkpt();
+
   // The round is complete (every expected contributor summed): seal the
   // contribution roster, encode the cached replies, release this
   // round's pending pulls, and replay parked pushes when a pull
@@ -431,6 +461,26 @@ class BytePSServer {
   // (BYTEPS_REPLICA_OF); -1 = a normal training-plane server.
   int replica_of_ = -1;
   std::thread replica_thread_;
+
+  // --- durable checkpoints (ISSUE 18) ---
+  // BYTEPS_CKPT_DIR: spill root; empty = checkpointing off entirely
+  // (the server is then byte-for-byte the pre-checkpoint build: no
+  // writer thread, no metrics, no restore scan).
+  std::string ckpt_dir_;
+  int ckpt_every_ = 1;   // BYTEPS_CKPT_EVERY: spill every Nth version
+  int ckpt_retain_ = 2;  // BYTEPS_CKPT_RETAIN: on-disk dirs kept
+  std::string ckpt_chaos_;  // BYTEPS_CHAOS_CKPT: "" / truncate / bitflip
+  bool restore_armed_ = false;        // BYTEPS_CKPT_RESTORE
+  int64_t durable_version_ = -2;      // newest valid on disk (Start)
+  CkptWriter ckpt_writer_;
+  // Restore install state: the checkpoint is loaded from disk ONCE (on
+  // the first CMD_INIT_KEY, after the restore epoch arrived with the
+  // address book) into restored_, then drained key-by-key as the
+  // worker re-declares; restore_round_ is the fleet-committed epoch.
+  std::once_flag restore_once_;
+  std::mutex restore_mu_;
+  std::map<std::pair<uint16_t, int64_t>, CkptItem> restored_;
+  int64_t ckpt_restore_round_ = -1;
 };
 
 }  // namespace bps

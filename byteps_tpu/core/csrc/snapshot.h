@@ -212,6 +212,37 @@ class SnapStore {
     return out;
   }
 
+  // Durable-checkpoint spill support (ISSUE 18): materialize the cut
+  // Get(version) serves — the newest entry at-or-below `version` for
+  // every known key — as one list for the checkpoint writer. Entries
+  // share the store's immutable payload (shared_ptr, no copy), so
+  // collecting on an engine thread costs pointer work only. *complete
+  // reports whether EVERY known key contributed an entry: a key whose
+  // ring no longer reaches back to `version` would make the cut torn,
+  // and the writer must skip the spill rather than persist a partial
+  // checkpoint. Called with a COMMITTED version, completeness holds by
+  // the commit-gating construction.
+  std::vector<SnapDeltaEnt> CollectCut(int64_t version,
+                                       bool* complete) const {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<SnapDeltaEnt> out;
+    bool all = true;
+    for (const auto& kv : keys_) {
+      const auto& ring = kv.second;
+      bool found = false;
+      for (auto rit = ring.rbegin(); rit != ring.rend(); ++rit) {
+        if (rit->version <= version) {
+          out.push_back({kv.first.first, kv.first.second, *rit});
+          found = true;
+          break;
+        }
+      }
+      if (!found) all = false;
+    }
+    if (complete) *complete = all;
+    return out;
+  }
+
   size_t key_count() const {
     std::lock_guard<std::mutex> lk(mu_);
     return keys_.size();

@@ -80,10 +80,21 @@ void Trace::SetNode(int role, int node_id, int worker_rank) {
   }
   if (old_path.empty()) return;
   std::string dir = old_path.substr(0, old_path.find_last_of('/'));
+  // Same incarnation probing as FlightDumpAuto: the canonical name may
+  // already belong to a dead predecessor's dump — renaming over it
+  // would destroy the pre-crash half of the forensics.
   char new_path[512];
   snprintf(new_path, sizeof(new_path), "%s/flight_r%d_n%d.json",
            dir.c_str(), role, node_id);
-  ::rename(old_path.c_str(), new_path);
+  struct stat st {};
+  for (int k = 1; ::stat(new_path, &st) == 0 && k < 1000; ++k) {
+    snprintf(new_path, sizeof(new_path), "%s/flight_r%d_n%d_i%d.json",
+             dir.c_str(), role, node_id, k);
+  }
+  if (::rename(old_path.c_str(), new_path) == 0) {
+    std::lock_guard<std::mutex> lk(reason_mu_);
+    if (auto_dump_path_.empty()) auto_dump_path_ = new_path;
+  }
 }
 
 void Trace::SetClock(int64_t offset_us, int64_t rtt_us) {
@@ -281,8 +292,23 @@ long long Trace::FlightDumpAuto(const char* reason) {
   char path[512];
   int nid = node_id_.load(std::memory_order_relaxed);
   if (nid >= 0) {
-    snprintf(path, sizeof(path), "%s/flight_r%d_n%d.json", dir,
-             role_.load(std::memory_order_relaxed), nid);
+    // Probe for the first free incarnation name ONCE, then reuse it:
+    // a relaunch of the same role/node must not overwrite its
+    // predecessor's dump, but this process's own re-dumps should
+    // overwrite in place (see auto_dump_path_ in trace.h).
+    std::lock_guard<std::mutex> lk(reason_mu_);
+    if (auto_dump_path_.empty()) {
+      const int role = role_.load(std::memory_order_relaxed);
+      snprintf(path, sizeof(path), "%s/flight_r%d_n%d.json", dir, role,
+               nid);
+      struct stat st {};
+      for (int k = 1; ::stat(path, &st) == 0 && k < 1000; ++k) {
+        snprintf(path, sizeof(path), "%s/flight_r%d_n%d_i%d.json", dir,
+                 role, nid, k);
+      }
+      auto_dump_path_ = path;
+    }
+    snprintf(path, sizeof(path), "%s", auto_dump_path_.c_str());
   } else {
     // Pre-topology fatal: no node id yet; the pid keeps files distinct.
     // Remember the path — SetNode renames it to the role/node form if

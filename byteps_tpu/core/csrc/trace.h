@@ -213,6 +213,14 @@ class Trace {
   // (ISSUE 7 satellite); a process that dies pre-topology keeps the
   // pid name — the merge tool tolerates both. Guarded by reason_mu_.
   std::string pid_dump_path_;
+  // Incarnation-stable auto-dump path (ISSUE 18 satellite): a
+  // relaunched process of the SAME role/node-id must not overwrite its
+  // predecessor's dump — restart forensics need both sides of a crash.
+  // The first auto dump probes flight_r<role>_n<id>.json, then
+  // _i1/_i2/... for the first free name, and the choice is pinned here
+  // so this process's own re-dumps still overwrite in place.
+  // timeline.py labels the incarnations at merge. Guarded by reason_mu_.
+  std::string auto_dump_path_;
   std::mutex reason_mu_;
 };
 

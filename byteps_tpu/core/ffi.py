@@ -150,6 +150,14 @@ def _load() -> ctypes.CDLL:
     lib.bps_snap_probe.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                    ctypes.c_longlong]
     lib.bps_snap_probe.restype = ctypes.c_longlong
+    # Durable checkpoints (ISSUE 18): the fleet-free spill / scan /
+    # load / torn-rejection probe, plus the fleet-committed restore
+    # epoch this node learned at formation.
+    lib.bps_ckpt_probe.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                   ctypes.c_longlong]
+    lib.bps_ckpt_probe.restype = ctypes.c_longlong
+    lib.bps_restore_round.argtypes = []
+    lib.bps_restore_round.restype = ctypes.c_longlong
     _lib = lib
     return lib
 
@@ -271,6 +279,35 @@ def snap_probe(script: str) -> dict:
         if need < size:
             return json.loads(buf.value.decode())
         size = need + 1
+
+
+def ckpt_probe(script: str) -> dict:
+    """Drive the C core's standalone durable-checkpoint subsystem
+    (ISSUE 18) through a `;`-separated op script (dir:/rank:/chaos:/
+    spill:/retain:/scan:/list:/load:/tear:/crc:) and return the outcome
+    of every op — spill verdicts, newest-valid scan results, full valid
+    version lists, load fidelity, torn-write injections, CRC32C known
+    vectors. The no-fleet unit-test surface for the checksummed
+    spill / atomic-rename / manifest-sealed-last durability argument.
+    Raises ValueError on a malformed script."""
+    import json
+
+    lib = _load()
+    size = 1 << 16
+    while True:
+        buf = ctypes.create_string_buffer(size)
+        need = int(lib.bps_ckpt_probe(script.encode(), buf, size))
+        if need < 0:
+            raise ValueError(f"malformed ckpt probe script {script!r}")
+        if need < size:
+            return json.loads(buf.value.decode())
+        size = need + 1
+
+
+def restore_round() -> int:
+    """The fleet-committed durable-restore epoch this node learned from
+    the address book (ISSUE 18); -1 = none (ordinary cold start)."""
+    return int(_load().bps_restore_round())
 
 
 def tenant_id() -> int:
@@ -488,6 +525,19 @@ def _apply_config_env(cfg: Optional[Config]) -> None:
     os.environ["BYTEPS_SNAP_DELTA_MAX_BYTES"] = str(
         cfg.snap_delta_max_bytes)
     os.environ["BYTEPS_REPLICA_POLL_MS"] = str(cfg.replica_poll_ms)
+    # Durable checkpoints (ISSUE 18): spill knobs project only when the
+    # job armed a checkpoint dir — an unset BYTEPS_CKPT_DIR keeps the
+    # server byte-for-byte the pre-checkpoint build.
+    # BYTEPS_CKPT_RESTORE is deliberately NOT projected: like
+    # DMLC_RECOVER_RANK it is per-process identity (this relaunch
+    # resumes from disk), owned by the supervisor that spawned it.
+    if cfg.ckpt_dir:
+        os.environ["BYTEPS_CKPT_DIR"] = cfg.ckpt_dir
+        os.environ["BYTEPS_CKPT_EVERY"] = str(cfg.ckpt_every)
+        os.environ["BYTEPS_CKPT_RETAIN"] = str(cfg.ckpt_retain)
+        os.environ["BYTEPS_CKPT_LAG_WARN"] = str(cfg.ckpt_lag_warn)
+        if cfg.chaos_ckpt:
+            os.environ["BYTEPS_CHAOS_CKPT"] = cfg.chaos_ckpt
     os.environ["BYTEPS_CHAOS_SEED"] = str(cfg.chaos_seed)
     os.environ["BYTEPS_CHAOS_DROP"] = str(cfg.chaos_drop)
     os.environ["BYTEPS_CHAOS_DUP"] = str(cfg.chaos_dup)

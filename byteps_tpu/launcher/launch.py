@@ -216,6 +216,20 @@ def _reap(procs: List[subprocess.Popen], names: Optional[List[str]] = None,
     return rc
 
 
+def _has_sealed_checkpoint(ckpt_dir: str) -> bool:
+    """True when the spool holds at least one shard directory with a
+    sealed MANIFEST. Presence is all the launcher checks — rejecting a
+    torn or checksum-invalid spill is the restore scan's job, and a
+    restore attempt over nothing-valid fail-stops with the shard named
+    rather than cold-starting."""
+    try:
+        return any(n.startswith("ckpt_v")
+                   and os.path.exists(os.path.join(ckpt_dir, n, "MANIFEST"))
+                   for n in os.listdir(ckpt_dir))
+    except OSError:
+        return False
+
+
 def _free_port() -> int:
     import socket
 
@@ -536,10 +550,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "fleet's re-registrations. Worker deaths still "
                         "fail fast (pair with --elastic or --restarts "
                         "for those)")
+    p.add_argument("--ckpt-dir", metavar="DIR", default="",
+                   help="arm durable checkpoints for the whole fleet "
+                        "(BYTEPS_CKPT_DIR, docs/checkpoint.md): every "
+                        "server spills each BYTEPS_CKPT_EVERY-th "
+                        "committed snapshot version to DIR as CRC32C-"
+                        "checksummed chunks sealed by a manifest, off "
+                        "the training path. Pair with --restarts N for "
+                        "full-fleet-loss recovery: a relaunch after a "
+                        "failed run escalates to BYTEPS_CKPT_RESTORE=1 "
+                        "automatically once DIR holds a sealed "
+                        "checkpoint, so the fleet resumes from the last "
+                        "durable cut instead of cold-starting")
+    p.add_argument("--ckpt-every", type=int, metavar="N", default=0,
+                   help="spill every Nth committed snapshot version "
+                        "(BYTEPS_CKPT_EVERY; default inherit env, 1)")
+    p.add_argument("--restore", action="store_true",
+                   help="start the fleet in coordinated restore mode "
+                        "(BYTEPS_CKPT_RESTORE=1): servers scan their "
+                        "--ckpt-dir shards, the scheduler commits a "
+                        "restore epoch at the minimum checksum-valid "
+                        "version common to every shard, and workers "
+                        "resume from the round after it — or the fleet "
+                        "fail-stops with the missing shard named. "
+                        "Requires --ckpt-dir (or BYTEPS_CKPT_DIR)")
     p.add_argument("--restarts", type=int, default=0,
                    help="--local mode: relaunch the whole fleet up to N "
                         "times after a failed run (elastic-ish recovery: "
-                        "pair the training script with checkpoint/resume "
+                        "with --ckpt-dir the relaunch restores from the "
+                        "last sealed checkpoint; otherwise pair the "
+                        "training script with its own checkpoint/resume "
                         "so restarts continue from the last step)")
     p.add_argument("--restart-backoff", type=float, metavar="SECONDS",
                    default=1.0,
@@ -582,6 +622,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         os.environ["BYTEPS_ROUNDSTATS_ON"] = "0"
     if args.elastic:
         os.environ["BYTEPS_ELASTIC"] = "1"
+    if args.ckpt_dir:
+        os.environ["BYTEPS_CKPT_DIR"] = args.ckpt_dir
+    if args.ckpt_every > 0:
+        os.environ["BYTEPS_CKPT_EVERY"] = str(args.ckpt_every)
+    if args.restore:
+        if not os.environ.get("BYTEPS_CKPT_DIR", ""):
+            p.error("--restore requires --ckpt-dir (or BYTEPS_CKPT_DIR)")
+        os.environ["BYTEPS_CKPT_RESTORE"] = "1"
     if args.tenant is not None:
         # Multi-tenant PS (ISSUE 9): one launcher invocation = one job
         # = one tenant; every role it spawns carries the id, and
@@ -631,6 +679,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   file=sys.stderr)
             if delay > 0:
                 time.sleep(delay)
+            # Durable-checkpoint escalation (ISSUE 18): a dead fleet
+            # that was spilling checkpoints relaunches in restore mode,
+            # so the restart resumes from the last sealed cut instead of
+            # cold-starting from step 0 over the same spool.
+            ckpt_dir = os.environ.get("BYTEPS_CKPT_DIR", "")
+            if (ckpt_dir and _has_sealed_checkpoint(ckpt_dir)
+                    and not os.environ.get("BYTEPS_CKPT_RESTORE")):
+                os.environ["BYTEPS_CKPT_RESTORE"] = "1"
+                print(f"bpslaunch: sealed checkpoint(s) found in "
+                      f"{ckpt_dir} — escalating the relaunch to "
+                      f"BYTEPS_CKPT_RESTORE=1 (resume from the last "
+                      f"durable cut)", file=sys.stderr, flush=True)
             rc = launch_local_fleet(command, args.local, args.num_servers,
                                     args.port, dict(os.environ),
                                     numa=args.numa,

@@ -91,7 +91,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 # kill a fleet that is saving itself).
                 state = ("RECOVERING" if recovering
                          else "OK" if healthy else "DEGRADED")
-                body = json.dumps({
+                body = {
                     "status": "ok" if healthy else "degraded",
                     "state": state,
                     "inited": bool(node.get("inited")),
@@ -146,7 +146,29 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                         counters.get("bps_snap_pulls_total", 0)),
                     "uptime_s": round(
                         time.monotonic() - self.server.started_at, 3),
-                }).encode()
+                }
+                if "bps_ckpt_version" in gauges:
+                    # Durable checkpoints (ISSUE 18): only present when
+                    # the writer is armed (BYTEPS_CKPT_DIR) — an unarmed
+                    # fleet's health document stays byte-identical to
+                    # the pre-checkpoint one. lag_rounds is the distance
+                    # between the newest committed snapshot and the
+                    # newest sealed spill: a climbing lag means the disk
+                    # can't keep up and a crash now loses that many
+                    # rounds.
+                    body.update({
+                        "ckpt_version": int(
+                            gauges.get("bps_ckpt_version", -1)),
+                        "ckpt_lag_rounds": int(
+                            gauges.get("bps_ckpt_lag_rounds", 0)),
+                        "ckpt_spills": int(
+                            counters.get("bps_ckpt_spills_total", 0)),
+                        "ckpt_failures": int(
+                            counters.get("bps_ckpt_failures_total", 0)),
+                        "ckpt_spill_ms": int(
+                            gauges.get("bps_ckpt_spill_ms", 0)),
+                    })
+                body = json.dumps(body).encode()
                 ctype = "application/json"
                 code = 200 if healthy else 503
             else:

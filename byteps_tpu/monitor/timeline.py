@@ -34,6 +34,7 @@ import argparse
 import glob as _glob
 import json
 import os
+import re
 import statistics
 import sys
 from typing import Dict, List, Optional, Tuple
@@ -76,6 +77,16 @@ def _rank_label(meta: dict) -> str:
     return f"{role} (node {nid})"
 
 
+def _incarnation(meta: dict) -> int:
+    """Incarnation index from the dump filename: restart forensics
+    (crash-restart, restore-relaunch) leave multiple dumps for one
+    role/node — ``flight_rR_nN.json`` is the first life, and each
+    relaunch probes to ``flight_rR_nN_i<k>.json`` rather than
+    overwriting its predecessor's evidence."""
+    m = re.search(r"_i(\d+)\.json$", meta.get("path", "") or "")
+    return int(m.group(1)) if m else 0
+
+
 def merge_dumps(dumps: List[dict],
                 out_path: Optional[str] = None) -> dict:
     """Merge per-rank dumps into one fleet trace.
@@ -86,20 +97,40 @@ def merge_dumps(dumps: List[dict],
     Each rank becomes its own process row (pid = node id) with a
     ``process_name`` metadata record, so Perfetto shows one labelled
     track group per rank. Events are emitted in timestamp order.
+
+    Incarnations: when several dumps share one (role, node id) — a
+    crashed first life plus its restarted successor(s), distinguished
+    by the ``_i<k>`` filename suffix — each life gets its OWN labelled
+    row ("life k") instead of interleaving pre-crash and post-restart
+    events on one track.
     """
     events: List[dict] = []
     ranks = []
+    lives: Dict[Tuple[int, int], int] = {}
+    for d in dumps:
+        key = (d.get("meta", {}).get("role", -1),
+               d.get("meta", {}).get("node_id", -1))
+        if key[1] >= 0:
+            lives[key] = lives.get(key, 0) + 1
     for d in dumps:
         meta = d.get("meta", {})
         nid = meta.get("node_id", -1)
+        inc = _incarnation(meta)
         # A rank that never learned its id (pre-topology dump) still
         # gets a distinct row: fall back to a synthetic negative pid.
         pid = nid if nid >= 0 else -(len(ranks) + 1)
+        label = _rank_label(meta)
+        if nid >= 0 and lives.get((meta.get("role", -1), nid), 0) > 1:
+            # Distinct row per incarnation (node ids are small; the
+            # 100000 stride cannot collide with a real node id).
+            pid = nid + 100000 * inc
+            label = f"{label} [life {inc + 1}]"
         offset = int(meta.get("clock_offset_us", 0) or 0)
-        ranks.append({"pid": pid, "label": _rank_label(meta),
+        ranks.append({"pid": pid, "label": label,
                       "offset_us": offset,
                       "rtt_us": meta.get("clock_rtt_us", -1),
                       "dropped": meta.get("dropped", 0),
+                      "incarnation": inc,
                       "role": meta.get("role", -1)})
         for e in d.get("traceEvents", []):
             if "ts" not in e:

@@ -156,6 +156,34 @@ def _replica_rows(scrapes: Dict[str, Optional[dict]],
     return rows
 
 
+def _ckpt_rows(scrapes: Dict[str, Optional[dict]],
+               lag_warn: int = 8) -> Dict[str, dict]:
+    """Per-server durable-checkpoint state (ISSUE 18): the newest sealed
+    spill version, how many committed snapshot versions the writer
+    trails the training watermark, and spill traffic. A server is
+    CKPT-LAGGING when the lag exceeds BYTEPS_CKPT_LAG_WARN — the disk is
+    not keeping up, and a full-fleet loss right now costs that many
+    rounds of progress. Servers without the writer armed (no
+    bps_ckpt_version series) are omitted, so checkpoint-less fleets get
+    an unchanged report."""
+    rows: Dict[str, dict] = {}
+    for name, m in scrapes.items():
+        if not name.startswith("server") or m is None:
+            continue
+        if "bps_ckpt_version" not in m:
+            continue
+        lag = int(_sample(m, "bps_ckpt_lag_rounds"))
+        rows[name] = {
+            "ckpt_version": int(_sample(m, "bps_ckpt_version", -1)),
+            "lag_rounds": lag,
+            "spills": int(_sample(m, "bps_ckpt_spills_total")),
+            "failures": int(_sample(m, "bps_ckpt_failures_total")),
+            "spill_ms": int(_sample(m, "bps_ckpt_spill_ms")),
+            "lagging": lag > lag_warn,
+        }
+    return rows
+
+
 def analyze(scrapes: Dict[str, Optional[dict]],
             straggler_factor: float = 2.0,
             heartbeat_timeout_s: float = 30.0) -> dict:
@@ -325,6 +353,9 @@ def analyze(scrapes: Dict[str, Optional[dict]],
         scrapes,
         lag_rounds=int(_os.environ.get("BYTEPS_REPLICA_LAG_ROUNDS",
                                        "8") or 8))
+    ckpt = _ckpt_rows(
+        scrapes,
+        lag_warn=int(_os.environ.get("BYTEPS_CKPT_LAG_WARN", "8") or 8))
 
     return {
         "workers": workers,
@@ -332,6 +363,11 @@ def analyze(scrapes: Dict[str, Optional[dict]],
         "replicas": replicas,
         "lagging_replicas": sorted(
             (n for n, r in replicas.items() if r["lagging"]),
+            key=_rank_key),
+        # Durable checkpoints (ISSUE 18; docs/checkpoint.md).
+        "ckpt": ckpt,
+        "lagging_ckpt": sorted(
+            (n for n, r in ckpt.items() if r["lagging"]),
             key=_rank_key),
         # Multi-tenant rows (ISSUE 9; docs/multitenancy.md).
         "tenants": tenants,
@@ -447,8 +483,18 @@ def _print_report(report: dict, as_json: bool) -> None:
             flags = "REPLICA-LAGGING" if r["lagging"] else ""
             print(f"{name:<10} {r['snapshot_version']:>9} "
                   f"{r['lag_rounds']:>5} {r['snap_pulls']:>10} {flags}")
+    ckpt = report.get("ckpt") or {}
+    if ckpt:
+        print(f"{'server':<10} {'ckpt-ver':>9} {'lag':>5} {'spills':>7} "
+              f"{'fail':>5} {'spill ms':>8} flags")
+        for name in sorted(ckpt, key=_rank_key):
+            r = ckpt[name]
+            flags = "CKPT-LAGGING" if r["lagging"] else ""
+            print(f"{name:<10} {r['ckpt_version']:>9} "
+                  f"{r['lag_rounds']:>5} {r['spills']:>7} "
+                  f"{r['failures']:>5} {r['spill_ms']:>8} {flags}")
     for kind in ("retrying", "stale_nodes", "dead_nodes", "unreachable",
-                 "starved_tenants", "lagging_replicas"):
+                 "starved_tenants", "lagging_replicas", "lagging_ckpt"):
         if report.get(kind):
             print(f"{kind}: {report[kind]}")
 

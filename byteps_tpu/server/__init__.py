@@ -35,6 +35,18 @@ def main() -> None:
         print("byteps_tpu.server: starting as scheduler crash-restart "
               "(DMLC_SCHED_RECOVER) — waiting for the fleet's "
               "re-registration quorum", file=sys.stderr, flush=True)
+    if (os.environ.get("BYTEPS_CKPT_RESTORE", "")
+            and role in ("scheduler", "server")):
+        # Durable restore (ISSUE 18): this fleet resumes from disk. A
+        # server scans its BYTEPS_CKPT_DIR shard for the newest
+        # checksum-valid version and registers it; the scheduler commits
+        # a restore epoch at the minimum version common to EVERY shard —
+        # or refuses to start if any shard has nothing valid (a named
+        # fail-stop, never a silent cold start).
+        print(f"byteps_tpu.server: {role} starting in checkpoint-restore "
+              f"mode (BYTEPS_CKPT_RESTORE, dir "
+              f"{os.environ.get('BYTEPS_CKPT_DIR', '?')})",
+              file=sys.stderr, flush=True)
     replica_of = os.environ.get("BYTEPS_REPLICA_OF", "")
     if role == "replica":
         # Versioned snapshot serving (ISSUE 16): a read-only replica.

@@ -996,6 +996,104 @@ def main() -> int:
             }), flush=True)
             w.barrier(GROUP_WORKERS)
 
+        elif mode == "ckpt":
+            # Durable-checkpoint acceptance (ISSUE 18): a state-
+            # recurrent training loop where each round's push is a
+            # deterministic integer-float function of the PREVIOUS
+            # round's aggregate — so the full trajectory is recoverable
+            # from any one committed round, and bit-identity of the
+            # per-round digests proves the restored state byte-exact.
+            #   round r: push (state % 97 + 1) * (rank+1); the summed
+            #   aggregate becomes the next state. Fresh runs start from
+            #   a fixed base; a RESTORED run reconstructs state by
+            #   pulling the fleet-committed restore cut (version R)
+            #   from the servers' snapshot endpoints — worker state
+            #   comes FROM the restored servers, never from anything
+            #   that survived the crash locally.
+            import hashlib
+            import json
+            import time as _t
+
+            from byteps_tpu.core.ffi import restore_round
+
+            sizes = [64, 96, 128, 192, 256, 384, 512, 768, 1024,
+                     1536] * 3  # 30 tensors, 256 B .. 6 KiB
+            total = int(os.environ.get("BPS_TEST_ROUNDS", "12"))
+            sleep_s = float(os.environ.get("BPS_TEST_ROUND_SLEEP", "0"))
+            tids = [w.declare(f"ck{i}", n, "float32", compression="")
+                    for i, n in enumerate(sizes)]
+            scale = sum(r + 1 for r in range(nw))
+            bases = [(np.arange(n) % 23 + i + 1).astype(np.float32)
+                     for i, n in enumerate(sizes)]
+            R = restore_round()
+            if R >= 0 and os.environ.get("BPS_TEST_SNAP_ADDRS"):
+                # The declares above made every shard install + publish
+                # its restored aggregates at round R; pull that one
+                # committed cut (pinned, raw float32) as our state.
+                from byteps_tpu.client import SnapshotClient
+                addrs = os.environ["BPS_TEST_SNAP_ADDRS"].split(",")
+                keys = [tid << 16 for tid in tids]
+                # Short per-request timeout: a chaos-dropped serving
+                # reply must cost one quick failover, not a 30 s stall.
+                with SnapshotClient(endpoints=addrs, quant=False,
+                                    timeout=3.0) as c:
+                    version, vals = c.pull(keys, version=R)
+                assert version == R, (version, R)
+                states = [vals[k].copy() for k in keys]
+                for i, st in enumerate(states):
+                    assert st.shape == (sizes[i],), (i, st.shape)
+                start = R + 1
+            elif R >= 0:
+                # Restored fleet but no serving endpoints to rebuild
+                # worker state from (launcher-level escalation tests):
+                # resume the round counters at the restore cut with
+                # fresh base state. Digest bit-identity is only claimed
+                # by the tests that DO pull the cut.
+                states = [b.copy() for b in bases]
+                start = R + 1
+            else:
+                states = [b.copy() for b in bases]
+                start = 0
+            # Die-once hook: rank 0 simulates a mid-run preemption at
+            # the given round on its FIRST life (marker file), so a
+            # launcher --restarts relaunch can prove the escalation to
+            # restore mode end to end.
+            die_at = int(os.environ.get("BPS_TEST_DIE_AT_ROUND", "-1"))
+            die_marker = os.environ.get("BPS_TEST_DIE_MARKER", "")
+            digests = {}
+            for rnd in range(start, total):
+                if (rnd == die_at and rank == 0 and die_marker
+                        and not os.path.exists(die_marker)):
+                    with open(die_marker, "w") as f:
+                        f.write("died\n")
+                    print("simulating full-fleet preemption", flush=True)
+                    os._exit(1)
+                staged = []
+                for i, tid in enumerate(tids):
+                    arr = np.ascontiguousarray(
+                        (states[i] % 97 + 1) * (rank + 1))
+                    staged.append((w.push_pull(tid, arr, average=False),
+                                   arr, i))
+                dg = hashlib.sha256()
+                for h, arr, i in staged:
+                    w.wait(h)
+                    states[i] = arr.copy()
+                    dg.update(arr.tobytes())
+                digests[rnd] = dg.hexdigest()
+                print(f"round {rnd}", flush=True)
+                if sleep_s:
+                    _t.sleep(sleep_s)
+            w.barrier(GROUP_WORKERS)
+            snap = w.metrics_snapshot()["counters"]
+            print(json.dumps({
+                "digests": digests,
+                "restore_round": R,
+                "retries": snap.get("bps_retries_total", 0),
+                "chaos_injected": snap.get("bps_chaos_injected_total",
+                                           0),
+            }), flush=True)
+            w.barrier(GROUP_WORKERS)
+
         elif mode == "barrier":
             w.barrier(GROUP_WORKERS)
             print(f"rank {rank} passed barrier")

@@ -31,7 +31,8 @@ def pytest_collection_modifyitems(items):
     """Every multi-process topology test is also `slow`; the fast tier is
     `pytest -m "not slow"` (docs/testing in README)."""
     for item in items:
-        if "ps" in item.keywords or "serving" in item.keywords:
+        if ("ps" in item.keywords or "serving" in item.keywords
+                or "ckpt" in item.keywords):
             item.add_marker(pytest.mark.slow)
 
 

@@ -1,0 +1,261 @@
+"""Durable checkpoint acceptance (ISSUE 18).
+
+A real 2w x 2s training fleet spilling checksummed snapshot cuts to
+disk, then the worst case the subsystem exists for: SIGKILL EVERY
+process — workers, servers, scheduler — mid-run. The bars:
+
+ - Recovery: a fresh fleet relaunched with BYTEPS_CKPT_RESTORE=1
+   commits a restore epoch R at the minimum durable version common to
+   every shard, the servers re-seed their aggregates from disk, the
+   workers reconstruct their state FROM the restored servers (snapshot
+   pull of the restore cut), and every subsequent round's digest is
+   BIT-IDENTICAL to the same round of an uninterrupted run.
+ - Composition: the restored run reproduces the same digests with wire
+   chaos (drop + dup, fixed seed) injected on top — restore rides the
+   same exactness machinery as everything else.
+ - Fail-stop: if every spill was torn (BYTEPS_CHAOS_CKPT), the restore
+   fleet refuses to start with a named diagnostic — never a silent
+   cold start.
+
+Run the selection alone with `pytest -m ckpt`.
+"""
+
+import json
+import os
+import shutil
+import time
+
+import pytest
+
+from tests.ps_utils import (free_port, run_topology, spawn_role,
+                            spawn_worker, topology_env)
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_ps_worker.py")
+
+pytestmark = [pytest.mark.ps, pytest.mark.ckpt]
+
+ROUNDS = 12
+KILL_AFTER_ROUND = 6
+CKPT_ENV = {
+    "PS_HEARTBEAT_INTERVAL": "0.5",
+    "PS_HEARTBEAT_TIMEOUT": "2",
+    "BYTEPS_SNAPSHOT_RETAIN": "6",
+    "BYTEPS_CKPT_EVERY": "1",
+    "BYTEPS_CKPT_RETAIN": "4",
+    "BYTEPS_RETRY_TIMEOUT_MS": "300",
+    "BYTEPS_RECONNECT_BACKOFF_MS": "50",
+    "BYTEPS_LOG_LEVEL": "INFO",
+    "BPS_TEST_ROUNDS": str(ROUNDS),
+}
+
+
+def _rows(outputs):
+    return [json.loads(ln) for o in outputs for ln in o.splitlines()
+            if ln.startswith("{")]
+
+
+_ref_cache = {}
+
+
+def _reference_digests():
+    """Per-round digests of an UNINTERRUPTED ckpt-free run (cached):
+    the bit-identity oracle every restored run is held to. Also proves
+    the two workers agree with each other round by round."""
+    if "digests" not in _ref_cache:
+        outs = run_topology(2, 2, WORKER, mode="ckpt", extra=dict(CKPT_ENV),
+                            timeout=180.0)
+        rows = _rows(outs)
+        assert len(rows) == 2, outs
+        assert rows[0]["digests"] == rows[1]["digests"], rows
+        assert rows[0]["restore_round"] == -1, rows
+        _ref_cache["digests"] = rows[0]["digests"]
+    return _ref_cache["digests"]
+
+
+def _wait_for_round(worker, rnd, timeout_s=120.0):
+    deadline = time.time() + timeout_s
+    for line in worker.stdout:
+        if line.startswith(f"round {rnd}"):
+            return
+        if time.time() > deadline:
+            break
+    raise AssertionError(f"worker never reached round {rnd}")
+
+
+def _spawn_ckpt_fleet(ckpt_dir, extra=None, restore=False,
+                      snap_ports=None, chaos_ckpt=""):
+    """Scheduler + 2 servers (pinned shard ranks) + 2 ckpt-mode
+    workers. Returns (sched, servers, workers)."""
+    port = free_port()
+    env = topology_env(2, 2, port, dict(CKPT_ENV, **(extra or {})))
+    env["BYTEPS_CKPT_DIR"] = ckpt_dir
+    if chaos_ckpt:
+        env["BYTEPS_CHAOS_CKPT"] = chaos_ckpt
+    sched = spawn_role("scheduler", env)
+    servers = []
+    for s in range(2):
+        senv = dict(env)
+        # Shard identity: DMLC_WORKER_ID is both the preferred rank at
+        # formation (deterministic id assignment) and the shard the
+        # restore scan reads — the server that loads shard s must BE
+        # server rank s.
+        senv["DMLC_WORKER_ID"] = str(s)
+        if restore:
+            senv["BYTEPS_CKPT_RESTORE"] = "1"
+        if snap_ports:
+            senv["BYTEPS_LISTEN_PORT"] = str(snap_ports[s])
+        servers.append(spawn_role("server", senv))
+    wextra = {}
+    if snap_ports:
+        wextra["BPS_TEST_SNAP_ADDRS"] = ",".join(
+            f"127.0.0.1:{p}" for p in snap_ports)
+    workers = [spawn_worker(WORKER, env, r, "ckpt", extra=wextra)
+               for r in range(2)]
+    return sched, servers, workers
+
+
+def _kill_all(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        p.communicate()
+
+
+_killed_cache = {}
+
+
+def _killed_checkpoint_dir(tmp_factory):
+    """Run the ckpt-armed fleet, SIGKILL every process mid-run, and
+    return the surviving on-disk checkpoint directory (cached; restore
+    tests each work on their own COPY, because a restored fleet keeps
+    spilling into — and pruning — its directory)."""
+    if "dir" not in _killed_cache:
+        base = tmp_factory.mktemp("ckpt_killed")
+        ckpt_dir = str(base / "spool")
+        os.makedirs(ckpt_dir)
+        sched, servers, workers = _spawn_ckpt_fleet(
+            ckpt_dir, extra={"BPS_TEST_ROUND_SLEEP": "0.3"})
+        procs = [sched] + servers + workers
+        try:
+            _wait_for_round(workers[0], KILL_AFTER_ROUND)
+        finally:
+            # Full-fleet loss: nothing exits cleanly, nothing flushes.
+            _kill_all(procs)
+        shards = [d for d in os.listdir(ckpt_dir)
+                  if d.startswith("ckpt_v")]
+        assert shards, f"no checkpoints spilled before the kill: {ckpt_dir}"
+        _killed_cache["dir"] = ckpt_dir
+    return _killed_cache["dir"]
+
+
+def _run_restore(ckpt_dir, extra=None):
+    """Relaunch a fresh fleet in restore mode over `ckpt_dir`; reap
+    everything (all must exit 0) and return the worker JSON rows."""
+    snap_ports = [free_port(), free_port()]
+    sched, servers, workers = _spawn_ckpt_fleet(
+        ckpt_dir, extra=extra, restore=True, snap_ports=snap_ports)
+    procs = [("scheduler", sched), ("server0", servers[0]),
+             ("server1", servers[1]), ("worker0", workers[0]),
+             ("worker1", workers[1])]
+    outs = []
+    try:
+        for name, p in procs:
+            out, _ = p.communicate(timeout=180)
+            assert p.returncode == 0, f"{name} exited {p.returncode}:\n{out}"
+            if name.startswith("worker"):
+                outs.append(out)
+    finally:
+        _kill_all([p for _, p in procs])
+    return _rows(outs)
+
+
+def test_full_fleet_loss_restores_bit_identically(tmp_path_factory):
+    """SIGKILL the whole fleet mid-run; relaunch with restore armed.
+    Every post-restore round's digest must equal the uninterrupted
+    run's digest for the same round, bit for bit."""
+    reference = _reference_digests()
+    killed = _killed_checkpoint_dir(tmp_path_factory)
+    work = str(tmp_path_factory.mktemp("ckpt_restore") / "spool")
+    shutil.copytree(killed, work)
+
+    rows = _run_restore(work)
+    assert len(rows) == 2, rows
+    r0, r1 = rows
+    R = r0["restore_round"]
+    assert R == r1["restore_round"]
+    # The kill landed around round KILL_AFTER_ROUND with every=1 spills:
+    # the fleet must resume from a real mid-run epoch, not round 0 and
+    # not the end of the run.
+    assert 1 <= R <= ROUNDS - 2, R
+    resumed = sorted(int(k) for k in r0["digests"])
+    assert resumed == list(range(R + 1, ROUNDS)), (R, resumed)
+    for rnd in resumed:
+        assert r0["digests"][str(rnd)] == reference[str(rnd)], (
+            f"round {rnd} diverged after restore")
+        assert r1["digests"][str(rnd)] == reference[str(rnd)], (
+            f"round {rnd} diverged after restore (worker 1)")
+
+
+def test_restore_composes_with_wire_chaos(tmp_path_factory):
+    """The restored run reproduces the reference digests with wire
+    chaos (drop + dup, fixed seed) injected on top — the retry/dedup
+    machinery and the restore epoch compose."""
+    reference = _reference_digests()
+    killed = _killed_checkpoint_dir(tmp_path_factory)
+    work = str(tmp_path_factory.mktemp("ckpt_chaos") / "spool")
+    shutil.copytree(killed, work)
+
+    rows = _run_restore(work, extra={
+        "BYTEPS_CHAOS_SEED": "42",
+        "BYTEPS_CHAOS_DROP": "0.02",
+        "BYTEPS_CHAOS_DUP": "0.02",
+    })
+    assert len(rows) == 2, rows
+    R = rows[0]["restore_round"]
+    assert 1 <= R <= ROUNDS - 2, R
+    assert sum(r["chaos_injected"] for r in rows) > 0, (
+        "chaos never fired — the composition was not exercised")
+    for row in rows:
+        for rnd, dg in row["digests"].items():
+            assert dg == reference[rnd], (
+                f"round {rnd} diverged under chaos after restore")
+
+
+def test_torn_spills_fail_stop_restore_with_named_diagnostic(
+        tmp_path_factory):
+    """BYTEPS_CHAOS_CKPT tears every spill; the armed run itself is
+    oblivious (training finishes clean), but a later restore must
+    refuse with the shard named — never silently cold-start."""
+    base = tmp_path_factory.mktemp("ckpt_torn")
+    ckpt_dir = str(base / "spool")
+    os.makedirs(ckpt_dir)
+    # Armed run with every spill corrupted pre-seal; training itself
+    # must be untouched (the writer is off the critical path).
+    sched, servers, workers = _spawn_ckpt_fleet(
+        ckpt_dir, chaos_ckpt="bitflip")
+    procs = [("scheduler", sched), ("server0", servers[0]),
+             ("server1", servers[1]), ("worker0", workers[0]),
+             ("worker1", workers[1])]
+    try:
+        for name, p in procs:
+            out, _ = p.communicate(timeout=180)
+            assert p.returncode == 0, f"{name} exited {p.returncode}:\n{out}"
+    finally:
+        _kill_all([p for _, p in procs])
+
+    # Restore attempt: every shard scans to "nothing valid" and the
+    # scheduler fail-stops at formation with the diagnostic named.
+    snap_ports = [free_port(), free_port()]
+    sched, servers, workers = _spawn_ckpt_fleet(
+        ckpt_dir, restore=True, snap_ports=snap_ports)
+    try:
+        sched_out, _ = sched.communicate(timeout=120)
+    finally:
+        _kill_all([sched] + servers + workers)
+    assert sched.returncode != 0, (
+        f"scheduler accepted a restore with no valid checkpoint:\n"
+        f"{sched_out}")
+    assert "no checksum-valid checkpoint" in sched_out, sched_out
+    assert "refusing a silent cold start" in sched_out, sched_out

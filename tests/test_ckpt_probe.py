@@ -1,0 +1,200 @@
+"""Durable checkpoints — fast tier (ISSUE 18).
+
+Unit-tests the checkpoint subsystem's durability arithmetic through the
+``bps_ckpt_probe`` FFI hook (no fleet): spill/scan/load roundtrip and
+payload fidelity, the manifest seal (every torn-write mode must make the
+version invisible to the scan), prior-valid-version fallback, bounded
+retention, the BYTEPS_CHAOS_CKPT self-invalidation contract, per-rank
+shard separation, the CRC32C check vector, and the config validation for
+the new knobs. The end-to-end fleet path — SIGKILL everything, restore,
+bit-identical resume — is covered by ``pytest -m ckpt`` (test_ckpt.py).
+
+Probe DSL (c_api.cc): ``dir:<d>;rank:<r>;chaos:<m>;spill:<v>,<nkeys>;
+retain:<n>;scan:0;list:0;load:<v>;tear:<v>,<mode>;crc:<text>``.
+Spilled item i holds 16 float32s of value v*1000+i under tenant i%2.
+Tear modes: 0 truncate MANIFEST, 1 truncate chunk_0, 2 bitflip chunk_0,
+3 delete MANIFEST.
+"""
+
+import pytest
+
+from byteps_tpu.config import Config
+
+
+def _probe(script):
+    from byteps_tpu.core.ffi import ckpt_probe
+    return ckpt_probe(script)
+
+
+# --- spill / scan / load roundtrip ------------------------------------------
+
+def test_spill_scan_load_roundtrip(tmp_path):
+    r = _probe(f"dir:{tmp_path};spill:2,3;spill:4,3;scan:0;load:4")
+    assert r["spills"] == [1, 1]
+    assert r["scans"] == [4]          # newest checksum-valid version
+    ok, round_, items, first = r["loads"][0]
+    assert ok == 1
+    assert round_ == 4                # manifest round watermark
+    assert items == 3
+    assert first == 4000              # item 0 payload = v*1000+0
+
+
+def test_scan_empty_dir_reports_nothing_valid(tmp_path):
+    r = _probe(f"dir:{tmp_path};scan:0;list:0")
+    assert r["scans"] == [-1]
+    assert r["lists"] == [[]]
+
+
+def test_load_missing_version_fails_cleanly(tmp_path):
+    r = _probe(f"dir:{tmp_path};spill:2,1;load:9")
+    assert r["loads"][0][0] == 0
+
+
+# --- torn writes: the manifest seal rejects every corruption mode -----------
+
+@pytest.mark.parametrize("mode", [0, 1, 2, 3], ids=[
+    "truncate-manifest", "truncate-chunk", "bitflip-chunk",
+    "delete-manifest"])
+def test_torn_version_is_invisible_and_unloadable(tmp_path, mode):
+    r = _probe(f"dir:{tmp_path};spill:3,2;tear:3,{mode};scan:0;load:3")
+    assert r["tears"] == [1]
+    assert r["scans"] == [-1]         # never installed, never offered
+    assert r["loads"][0][0] == 0
+
+
+@pytest.mark.parametrize("mode", [0, 1, 2, 3], ids=[
+    "truncate-manifest", "truncate-chunk", "bitflip-chunk",
+    "delete-manifest"])
+def test_torn_newest_falls_back_to_prior_valid(tmp_path, mode):
+    # The scan must skip a torn newest version and land on the newest
+    # version that still checks out — a half-written spill at crash
+    # time costs one checkpoint interval, never the whole history.
+    r = _probe(f"dir:{tmp_path};spill:2,2;spill:4,2;tear:4,{mode};"
+               "scan:0;load:2")
+    assert r["scans"] == [2]
+    ok, round_, items, first = r["loads"][0]
+    assert (ok, round_, items, first) == (1, 2, 2, 2000)
+
+
+# --- chaos: BYTEPS_CHAOS_CKPT spills are self-invalidating ------------------
+
+@pytest.mark.parametrize("mode", ["truncate", "bitflip"])
+def test_chaos_spill_self_invalidates(tmp_path, mode):
+    # Chaos corrupts chunk 0 AFTER its CRC is recorded and BEFORE the
+    # manifest seals, modelling a torn write the process itself never
+    # notices: the spill reports success (the writer is oblivious —
+    # that is the point of the injection), but the version must never
+    # become restorable.
+    r = _probe(f"dir:{tmp_path};chaos:{mode};spill:2,2;scan:0;load:2")
+    assert r["spills"] == [1]
+    assert r["scans"] == [-1]
+    assert r["loads"][0][0] == 0
+
+
+def test_chaos_off_then_on_keeps_prior_valid(tmp_path):
+    r = _probe(f"dir:{tmp_path};spill:2,2;chaos:bitflip;spill:4,2;"
+               "chaos:none;scan:0")
+    assert r["spills"] == [1, 1]  # the writer never notices the tear
+    assert r["scans"] == [2]      # ...but the scan does
+
+
+# --- retention ---------------------------------------------------------------
+
+def test_retention_prunes_oldest_versions(tmp_path):
+    r = _probe(f"dir:{tmp_path};spill:2,1;spill:4,1;spill:6,1;list:0;"
+               "retain:2;list:0;scan:0")
+    assert r["lists"][0] == [2, 4, 6]
+    assert r["lists"][1] == [4, 6]    # oldest pruned first
+    assert r["scans"] == [6]          # newest untouched
+
+
+def test_retention_never_prunes_below_floor(tmp_path):
+    r = _probe(f"dir:{tmp_path};spill:2,1;retain:1;list:0;load:2")
+    assert r["lists"][0] == [2]
+    assert r["loads"][0][0] == 1
+
+
+# --- shard separation --------------------------------------------------------
+
+def test_ranks_are_separate_shards(tmp_path):
+    # Two server ranks spill different versions into ONE directory;
+    # each rank's scan/load must see only its own shard.
+    r = _probe(f"dir:{tmp_path};rank:0;spill:2,1;rank:1;spill:4,1;"
+               "scan:0;rank:0;scan:0;load:2")
+    assert r["scans"] == [4, 2]       # rank 1's scan, then rank 0's
+    assert r["loads"][0][:2] == [1, 2]
+
+
+def test_tearing_one_rank_leaves_the_other(tmp_path):
+    r = _probe(f"dir:{tmp_path};rank:0;spill:2,1;rank:1;spill:2,1;"
+               "tear:2,2;scan:0;rank:0;scan:0")
+    assert r["scans"] == [-1, 2]      # rank 1 torn; rank 0 intact
+
+
+# --- CRC32C ------------------------------------------------------------------
+
+def test_crc32c_check_vector():
+    # The canonical Castagnoli check vector: Crc32c("123456789")
+    # must be 0xE3069283 (RFC 3720 appendix). A polynomial or
+    # reflection bug in the checksum breaks every manifest.
+    r = _probe("crc:123456789")
+    assert r["crcs"] == [0xE3069283]
+
+
+def test_crc32c_distinguishes_near_misses():
+    r = _probe("crc:123456789;crc:123456788;crc:")
+    assert len(set(r["crcs"])) == 3
+
+
+# --- probe hygiene -----------------------------------------------------------
+
+def test_probe_rejects_malformed_script():
+    with pytest.raises(ValueError):
+        _probe("spill:oops")
+    with pytest.raises(ValueError):
+        _probe("no_such_op:1")
+
+
+# --- config validation -------------------------------------------------------
+
+def test_config_ckpt_knob_floors():
+    with pytest.raises(ValueError, match="BYTEPS_CKPT_EVERY"):
+        Config(ckpt_every=0).validate()
+    with pytest.raises(ValueError, match="BYTEPS_CKPT_RETAIN"):
+        Config(ckpt_retain=0).validate()
+    with pytest.raises(ValueError, match="BYTEPS_CKPT_LAG_WARN"):
+        Config(ckpt_lag_warn=0).validate()
+
+
+def test_config_ckpt_requires_snapshots():
+    with pytest.raises(ValueError, match="BYTEPS_SNAPSHOT_RETAIN"):
+        Config(ckpt_dir="/tmp/ck", snapshot_retain=0).validate()
+    Config(ckpt_dir="/tmp/ck").validate()  # default retain is fine
+
+
+def test_config_restore_requires_dir():
+    with pytest.raises(ValueError, match="BYTEPS_CKPT_RESTORE"):
+        Config(ckpt_restore=True).validate()
+    Config(ckpt_dir="/tmp/ck", ckpt_restore=True).validate()
+
+
+def test_config_chaos_ckpt_validation():
+    with pytest.raises(ValueError, match="BYTEPS_CHAOS_CKPT"):
+        Config(ckpt_dir="/tmp/ck", chaos_ckpt="garble").validate()
+    with pytest.raises(ValueError, match="BYTEPS_CHAOS_CKPT"):
+        Config(chaos_ckpt="truncate").validate()
+    Config(ckpt_dir="/tmp/ck", chaos_ckpt="truncate").validate()
+
+
+def test_config_load_reads_ckpt_env(monkeypatch):
+    from byteps_tpu.config import load_config
+    monkeypatch.setenv("BYTEPS_CKPT_DIR", "/tmp/ckpts")
+    monkeypatch.setenv("BYTEPS_CKPT_EVERY", "5")
+    monkeypatch.setenv("BYTEPS_CKPT_RETAIN", "3")
+    monkeypatch.setenv("BYTEPS_CKPT_LAG_WARN", "16")
+    cfg = load_config()
+    assert cfg.ckpt_dir == "/tmp/ckpts"
+    assert cfg.ckpt_every == 5
+    assert cfg.ckpt_retain == 3
+    assert cfg.ckpt_lag_warn == 16
+    assert cfg.ckpt_restore is False

@@ -104,6 +104,60 @@ def test_local_fleet_end_to_end():
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
 
 
+def test_restore_flag_requires_ckpt_dir():
+    env = _env(DMLC_ROLE="worker")
+    env.pop("BYTEPS_CKPT_DIR", None)
+    r = _bpslaunch("--restore", "--", sys.executable, "-c", "pass",
+                   env=env)
+    assert r.returncode != 0
+    assert "requires --ckpt-dir" in r.stderr
+
+
+def test_ckpt_flags_project_env():
+    code = ("import os; "
+            "assert os.environ['BYTEPS_CKPT_DIR'] == '/tmp/bps_spool'; "
+            "assert os.environ['BYTEPS_CKPT_EVERY'] == '3'")
+    r = _bpslaunch("--ckpt-dir", "/tmp/bps_spool", "--ckpt-every", "3",
+                   "--", sys.executable, "-c", code,
+                   env=_env(DMLC_ROLE="worker"))
+    assert r.returncode == 0, r.stderr
+
+
+@pytest.mark.ps
+@pytest.mark.ckpt
+def test_ckpt_restarts_escalate_to_restore(tmp_path):
+    """--ckpt-dir + --restarts is the operator-facing full-fleet-loss
+    loop: the first life spills sealed checkpoints and dies mid-run; the
+    relaunch must escalate to BYTEPS_CKPT_RESTORE=1 (the launcher saw a
+    sealed manifest in the spool) and the second life must resume from a
+    committed restore epoch, not round 0."""
+    import json
+
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    marker = tmp_path / "died_once"
+    env = _env(BPS_TEST_MODE="ckpt",
+               BPS_TEST_ROUNDS="8",
+               BPS_TEST_DIE_AT_ROUND="5",
+               BPS_TEST_DIE_MARKER=str(marker),
+               BYTEPS_SNAPSHOT_RETAIN="4",
+               PS_HEARTBEAT_INTERVAL="0.5",
+               PS_HEARTBEAT_TIMEOUT="2",
+               BYTEPS_RETRY_TIMEOUT_MS="300",
+               BYTEPS_RECONNECT_BACKOFF_MS="50")
+    out = _bpslaunch("--local", "2", "--num-servers", "2",
+                     "--ckpt-dir", str(spool), "--restarts", "2", "--",
+                     sys.executable, WORKER, env=env, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "simulating full-fleet preemption" in out.stdout, out.stdout
+    assert ("escalating the relaunch to BYTEPS_CKPT_RESTORE=1"
+            in out.stderr), out.stderr
+    rows = [json.loads(ln) for ln in out.stdout.splitlines()
+            if ln.startswith("{")]
+    assert len(rows) == 2, (out.stdout, out.stderr)
+    assert all(r["restore_round"] >= 1 for r in rows), rows
+
+
 def test_restarts_rerun_failed_fleet(tmp_path):
     """--restarts relaunches the fleet after a failure; a worker that
     fails on its first life and succeeds on its second (via a marker

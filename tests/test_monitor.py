@@ -314,3 +314,36 @@ def test_top_flags_paced_straggler(tmp_path):
                 p.kill()
                 p.communicate()
     assert all(p.returncode == 0 for p in (sched, server, *workers))
+
+
+def _ckpt_server_metrics(version, lag, spills=0, failures=0, spill_ms=0):
+    return {
+        "bps_ckpt_version": {(): float(version)},
+        "bps_ckpt_lag_rounds": {(): float(lag)},
+        "bps_ckpt_spills_total": {(): float(spills)},
+        "bps_ckpt_failures_total": {(): float(failures)},
+        "bps_ckpt_spill_ms": {(): float(spill_ms)},
+    }
+
+
+def test_top_flags_ckpt_lagging_server(monkeypatch):
+    """ISSUE 18 satellite: a server whose durable spill trails the
+    training watermark past BYTEPS_CKPT_LAG_WARN is CKPT-LAGGING — a
+    full-fleet loss right now costs that many rounds. Servers without
+    the writer armed (no bps_ckpt_version series) stay out of the
+    report entirely."""
+    monkeypatch.setenv("BYTEPS_CKPT_LAG_WARN", "4")
+    scrapes = {
+        "server0": _ckpt_server_metrics(40, lag=2, spills=40, spill_ms=3),
+        "server1": _ckpt_server_metrics(30, lag=12, spills=30,
+                                        failures=1, spill_ms=80),
+        "server2": {},  # ckpt writer not armed
+    }
+    report = analyze(scrapes)
+    assert set(report["ckpt"]) == {"server0", "server1"}
+    assert report["lagging_ckpt"] == ["server1"]
+    row = report["ckpt"]["server1"]
+    assert row["ckpt_version"] == 30
+    assert row["lag_rounds"] == 12
+    assert row["failures"] == 1
+    assert report["ckpt"]["server0"]["lagging"] is False

@@ -297,3 +297,38 @@ def test_pid_named_flight_dump_gets_pid_label():
     merged = merge_dumps([d, json.loads(json.dumps(d))])
     pids = {e["pid"] for e in merged["traceEvents"]}
     assert len(pids) == 2
+
+
+def test_incarnation_dumps_get_separate_life_rows():
+    """ISSUE 18 satellite: a crashed first life and its restore-relaunch
+    successor dump under one (role, node) — ``flight_r1_n1.json`` and
+    ``flight_r1_n1_i1.json``. The merge must give each life its OWN
+    labelled row instead of interleaving pre-crash and post-restore
+    events on one track."""
+    first = _dump(1, 1, 0, [
+        _span("s_sum", 1, 7, ts=1_000, dur=100, round_=4)])
+    first["meta"]["path"] = "/traces/flight_r1_n1.json"
+    second = _dump(1, 1, 0, [
+        _span("s_sum", 1, 7, ts=9_000, dur=100, round_=6)])
+    second["meta"]["path"] = "/traces/flight_r1_n1_i1.json"
+    merged = merge_dumps([first, second])
+    labels = {e["args"]["name"] for e in merged["traceEvents"]
+              if e.get("ph") == "M"}
+    assert labels == {"server (node 1) [life 1]",
+                      "server (node 1) [life 2]"}
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert len(pids) == 2
+    incs = sorted(r["incarnation"] for r in merged["meta"]["ranks"])
+    assert incs == [0, 1]
+
+
+def test_sole_dump_keeps_plain_label_despite_suffix(tmp_path):
+    """A lone ``_i1`` dump (the first life's file was cleaned up) keeps
+    the plain label: the life suffix only appears when there is another
+    life to distinguish from."""
+    d = _dump(1, 2, 0, [_span("s_sum", 2, 7, ts=1_000, dur=100)])
+    d["meta"]["path"] = str(tmp_path / "flight_r1_n2_i1.json")
+    merged = merge_dumps([d])
+    labels = {e["args"]["name"] for e in merged["traceEvents"]
+              if e.get("ph") == "M"}
+    assert labels == {"server (node 2)"}

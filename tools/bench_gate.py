@@ -54,6 +54,7 @@ KNOWN_FAMILIES = frozenset({
     "core",         # BENCH_rNN.json (the original resnet bench)
     "async",
     "bert",
+    "ckpt",         # ISSUE 18: durable-checkpoint spill overhead + restore curve
     "compression",
     "elastic",
     "gate",
