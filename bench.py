@@ -267,6 +267,15 @@ def main() -> None:
                         "cold-start->restore-epoch-commit and ->shard "
                         "install on a full restart over it). Writes "
                         "--out (BENCH_ckpt_r17.json)")
+    p.add_argument("--integrity", action="store_true",
+                   help="ISSUE 19 artifact: wire-CRC cost on a live "
+                        "paced 2wx2s comm-round fleet — paired goodput "
+                        "with BYTEPS_WIRE_CRC off vs on (<5%% gate), "
+                        "plus a live corruption-chaos datapoint "
+                        "(seeded BYTEPS_CHAOS_CORRUPT under CRC: the "
+                        "fleet must keep completing exact rounds while "
+                        "bps_crc_fail_total climbs). Writes --out "
+                        "(BENCH_integrity_r19.json)")
     p.add_argument("--trace-overhead", action="store_true",
                    help="ISSUE 5 acceptance artifact: comm-only "
                         "small-tensor rounds over a real 2wx2s PS fleet "
@@ -295,6 +304,8 @@ def main() -> None:
         return bench_serving(args)
     if args.checkpoint:
         return bench_checkpoint(args)
+    if args.integrity:
+        return bench_integrity(args)
     if args.trace_overhead:
         return bench_trace_overhead(args)
     if args.insight_overhead:
@@ -1165,10 +1176,15 @@ def _serving_member_worker(args) -> None:
             time.sleep(round_sleep)
     window_s = time.time() - t0 if t0 else 0.0
     timed = max(rounds - warmup, 0)
+    counters = w.metrics_snapshot()["counters"]
     print(json.dumps({
         "rounds": rounds,
         "window_s": round(window_s, 3),
         "rounds_per_s": round(timed / window_s, 3) if window_s else 0.0,
+        # Wire-integrity evidence for bench_integrity's corruption
+        # datapoint (zero in every other configuration).
+        "crc_fails": counters.get("bps_crc_fail_total", 0),
+        "retries": counters.get("bps_retries_total", 0),
     }), flush=True)
     w.shutdown()
 
@@ -1629,6 +1645,164 @@ def bench_checkpoint(args) -> None:
     if overhead > 0.05:
         raise SystemExit("ckpt bench gate FAILED: spill overhead "
                          f"{overhead * 100:.1f}% > 5%")
+
+
+def bench_integrity(args) -> None:
+    """Wire-integrity bench (ISSUE 19 artifact), two questions:
+
+    1. What does the always-on CRC32C data plane cost? Paired paced
+       2wx2s comm-round fleets (same `_serving_member_worker` members,
+       training-shaped step cadence): BYTEPS_WIRE_CRC off vs on.
+       Gate: <5% rounds/s overhead, median of adjacent pairs with one
+       full re-measurement for scheduler-noise coin flips.
+    2. Does the fleet stay live under corruption? One CRC-on run with
+       seeded BYTEPS_CHAOS_CORRUPT: every member must keep completing
+       EXACT rounds (the member asserts each aggregate) while
+       bps_crc_fail_total climbs and retries absorb the drops.
+    """
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    from tools.shaped_fleet import free_port
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    window_s = float(os.environ.get("BPS_INTEG_BENCH_WINDOW_S", "8"))
+    nkeys = int(os.environ.get("BPS_INTEG_BENCH_KEYS", "16"))
+    pairs_n = int(os.environ.get("BPS_INTEG_BENCH_PAIRS", "3"))
+    # Training-shaped pacing (see bench_checkpoint's rationale): unpaced
+    # comm-spin measures header-processing, not the wire a real job sees.
+    round_sleep_ms = os.environ.get("BPS_INTEG_BENCH_ROUND_SLEEP_MS",
+                                    "40")
+
+    def run_fleet(extra_env=None):
+        td = tempfile.mkdtemp(prefix="bps_integ_bench_")
+        stop_file = os.path.join(td, "stop")
+        port = free_port()
+        env = dict(os.environ)
+        env.update({
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": "2",
+            "DMLC_NUM_SERVER": "2",
+            "PS_HEARTBEAT_INTERVAL": "1",
+            "BPS_SERVING_BENCH_KEYS": str(nkeys),
+            "BPS_SERVING_BENCH_ROUND_SLEEP_MS": round_sleep_ms,
+            "BPS_BENCH_STOP_FILE": stop_file,
+            "PYTHONPATH": repo,
+        })
+        env.update(extra_env or {})
+        procs = []
+        for role in ("scheduler", "server", "server"):
+            e = dict(env)
+            e["DMLC_ROLE"] = role
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.server"], env=e))
+        workers = []
+        for rank in range(2):
+            e = dict(env)
+            e["DMLC_ROLE"] = "worker"
+            e["DMLC_WORKER_ID"] = str(rank)
+            workers.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--role", "serving_member_worker"],
+                env=e, stdout=subprocess.PIPE, text=True))
+        procs += workers
+        try:
+            time.sleep(2.0)  # fleet up + warmup headroom
+            time.sleep(window_s)
+            with open(stop_file, "w") as f:
+                f.write("stop\n")
+            rows = []
+            for wp in workers:
+                out, _ = wp.communicate(timeout=120)
+                if wp.returncode != 0:
+                    raise SystemExit(f"fleet member failed:\n{out}")
+                rows += [json.loads(ln) for ln in out.splitlines()
+                         if ln.startswith("{")]
+            for pr in procs:
+                if pr not in workers:
+                    pr.wait(timeout=60)
+        finally:
+            for pr in procs:
+                if pr.poll() is None:
+                    pr.kill()
+        return {
+            "rounds_per_s": min(r["rounds_per_s"] for r in rows),
+            "crc_fails": sum(r.get("crc_fails", 0) for r in rows),
+            "retries": sum(r.get("retries", 0) for r in rows),
+        }
+
+    def measure_overhead():
+        prs = []
+        for _ in range(pairs_n):
+            b = run_fleet()
+            a = run_fleet({"BYTEPS_WIRE_CRC": "1"})
+            prs.append((b["rounds_per_s"], a["rounds_per_s"]))
+        ratios = sorted(a / b for b, a in prs)
+        return prs, ratios[len(ratios) // 2]
+
+    pairs, ratio = measure_overhead()
+    overhead = 1 - ratio
+    retried = False
+    if overhead > 0.05:
+        retried = True
+        pairs, ratio = measure_overhead()
+        overhead = 1 - ratio
+
+    # Liveness under corruption: the members assert every aggregate
+    # exactly, so a nonzero rounds count here IS the correctness proof.
+    corrupt = run_fleet({
+        "BYTEPS_WIRE_CRC": "1",
+        "BYTEPS_CHAOS_SEED": "42",
+        "BYTEPS_CHAOS_CORRUPT": "0.005",
+        "BYTEPS_RETRY_TIMEOUT_MS": "200",
+        "BYTEPS_RECONNECT_BACKOFF_MS": "50",
+    })
+    if corrupt["crc_fails"] <= 0:
+        raise SystemExit(
+            "corruption run detected no CRC failures — the chaos dice "
+            f"or the verifier is dead: {corrupt}")
+
+    doc = {
+        "what": ("wire integrity (ISSUE 19): paired CRC32C data-plane "
+                 f"overhead on a live paced 2wx2s comm-round fleet "
+                 f"({nkeys} float32[4096] tensors, {round_sleep_ms} ms "
+                 f"step cadence; median ratio of {pairs_n} adjacent "
+                 "off/on pairs) plus a corruption-liveness datapoint: "
+                 "seeded BYTEPS_CHAOS_CORRUPT under CRC, members "
+                 "asserting every aggregate exact while crc failures "
+                 "are absorbed by retries"),
+        "workers": 2,
+        "servers": 2,
+        "window_s": window_s,
+        "pairs": [{"crc_off_rounds_per_s": b, "crc_on_rounds_per_s": a,
+                   "ratio": round(a / b, 4)} for b, a in pairs],
+        "median_pair_ratio": round(ratio, 4),
+        "retried": retried,
+        "corruption_liveness": {
+            "chaos_corrupt": 0.005,
+            "rounds_per_s": corrupt["rounds_per_s"],
+            "crc_fails": corrupt["crc_fails"],
+            "retries": corrupt["retries"],
+        },
+        "gate": {
+            "crc_overhead_pct": round(overhead * 100, 1),
+            "threshold_pct": 5.0,
+            "pass": overhead <= 0.05,
+        },
+    }
+    print(json.dumps({"metric": "crc_overhead_pct",
+                      "value": round(overhead * 100, 1),
+                      "gate_pass": overhead <= 0.05}))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps({"artifact": args.out}))
+    if overhead > 0.05:
+        raise SystemExit("integrity bench gate FAILED: wire-CRC "
+                         f"overhead {overhead * 100:.1f}% > 5%")
 
 
 def bench_elastic(args) -> None:

@@ -45,6 +45,7 @@ CMD_SNAP_PULL = 34
 CMD_SNAP_RESP = 35
 
 FLAG_WIRE_QUANT = 4
+FLAG_WIRE_CRC = 16
 
 # MsgHeader: cmd i16, tenant u16, sender i32, key i64, req_id i32,
 # dtype i32, payload_len i64, flags i32, version i32, arg0 i64, arg1 i64,
@@ -52,6 +53,32 @@ FLAG_WIRE_QUANT = 4
 _HEADER_FMT = "<hHiqiiqiiqqq"
 _HEADER_LEN = struct.calcsize(_HEADER_FMT)
 assert _HEADER_LEN == 64
+
+
+def _crc32c_table() -> List[int]:
+    # CRC32C (Castagnoli), reflected polynomial 0x82F63B78 — the same
+    # table csrc/crc32c.cc builds. Stdlib-only on purpose: zlib.crc32 is
+    # the WRONG polynomial (0xEDB88320) and an inference host carries no
+    # C core to borrow the real one from.
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_CRC32C_TABLE = _crc32c_table()
+
+
+def crc32c(data: bytes, seed: int = 0) -> int:
+    """CRC32C over ``data`` (mirror of csrc/crc32c.cc Crc32c, including
+    its seed-chaining property: crc32c(a + b) == crc32c(b, crc32c(a)))."""
+    c = (seed ^ 0xFFFFFFFF) & 0xFFFFFFFF
+    for byte in data:
+        c = _CRC32C_TABLE[(c ^ byte) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
 
 # Snapshot miss codes (csrc/snapshot.h SnapStore::Get).
 SNAP_OK = 0
@@ -129,13 +156,22 @@ class SnapshotClient:
 
     def __init__(self, endpoints: Optional[Sequence[Endpoint]] = None,
                  tenant: int = 0, quant: bool = True,
-                 timeout: float = 5.0):
+                 timeout: float = 5.0,
+                 wire_crc: Optional[bool] = None):
         eps = ([_parse_endpoint(e) for e in endpoints]
                if endpoints else _endpoints_from_env())
         self.endpoints = eps
         self.tenant = int(tenant)
         self.quant = bool(quant)
         self.timeout = float(timeout)
+        # Wire integrity (ISSUE 19): stamp CRC32C trailers on requests
+        # when the fleet runs CRC-on (default: follow BYTEPS_WIRE_CRC).
+        # Replies are verified whenever THEY carry the flag, regardless
+        # of this setting — the flag on the frame is the contract.
+        if wire_crc is None:
+            v = os.environ.get("BYTEPS_WIRE_CRC", "")
+            wire_crc = bool(v) and v != "0"
+        self.wire_crc = bool(wire_crc)
         self._sock: Optional[socket.socket] = None
         self._ep_idx = 0
         self._req_id = 0
@@ -195,10 +231,21 @@ class SnapshotClient:
         s = self._connect()
         self._req_id += 1
         flags = FLAG_WIRE_QUANT if self.quant else 0
-        head = struct.pack(_HEADER_FMT, CMD_SNAP_PULL, self.tenant, -1,
-                           int(key), self._req_id, 0, 0, flags,
-                           int(version), 0, 0, 0)
-        s.sendall(struct.pack("<Q", _HEADER_LEN) + head)
+        if self.wire_crc:
+            # The request's payload is just the 4-byte trailer: CRC over
+            # the final header (flag set, payload_len counting the
+            # trailer), exactly the van's stamping contract.
+            flags |= FLAG_WIRE_CRC
+            head = struct.pack(_HEADER_FMT, CMD_SNAP_PULL, self.tenant,
+                               -1, int(key), self._req_id, 0, 4, flags,
+                               int(version), 0, 0, 0)
+            trailer = struct.pack("<I", crc32c(head))
+            s.sendall(struct.pack("<Q", _HEADER_LEN + 4) + head + trailer)
+        else:
+            head = struct.pack(_HEADER_FMT, CMD_SNAP_PULL, self.tenant,
+                               -1, int(key), self._req_id, 0, 0, flags,
+                               int(version), 0, 0, 0)
+            s.sendall(struct.pack("<Q", _HEADER_LEN) + head)
         total = struct.unpack("<Q", self._recv_exact(s, 8))[0]
         if not (_HEADER_LEN <= total <= (1 << 34)):
             raise ConnectionError(f"insane frame length {total}")
@@ -206,6 +253,24 @@ class SnapshotClient:
         (cmd, _tenant, _sender, rkey, _req, dtype, payload_len, rflags,
          rversion, arg0, arg1, _seq) = struct.unpack_from(_HEADER_FMT,
                                                           frame, 0)
+        if rflags & FLAG_WIRE_CRC:
+            # Verify BEFORE trusting a single payload byte, then strip
+            # the trailer — a mismatch is a transport error (the
+            # failover wrapper burns retry budget on it), NEVER garbage
+            # floats handed to the caller.
+            if payload_len < 4 or _HEADER_LEN + payload_len > len(frame):
+                raise ConnectionError(
+                    f"snapshot reply CRC frame malformed (payload_len="
+                    f"{payload_len}, frame={len(frame)})")
+            end = _HEADER_LEN + payload_len
+            (want,) = struct.unpack_from("<I", frame, end - 4)
+            got = crc32c(frame[:end - 4])
+            if got != want:
+                raise ConnectionError(
+                    f"snapshot reply for key {rkey} failed CRC32C "
+                    f"verification (got {got:#010x}, want {want:#010x})")
+            payload_len -= 4
+            rflags &= ~FLAG_WIRE_CRC
         if cmd != CMD_SNAP_RESP or rkey != key:
             raise ConnectionError(
                 f"unexpected reply cmd={cmd} key={rkey} (want "

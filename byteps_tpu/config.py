@@ -285,9 +285,26 @@ class Config:
     #   when its latest committed snapshot version leads its last
     #   durably spilled version by more than this many rounds
     chaos_ckpt: str = ""                  # BYTEPS_CHAOS_CKPT
-    #   torn-write injection ("truncate" | "bitflip"): corrupt chunk 0
-    #   of every spill AFTER its CRC is recorded but BEFORE the manifest
-    #   is sealed — the restore scan must reject the version
+    #   torn-write injection ("truncate" | "bitflip" | "sealflip"):
+    #   corrupt a seeded-random chunk (truncate/bitflip) or the sealed
+    #   MANIFEST itself (sealflip) of every spill AFTER its CRC is
+    #   recorded — the restore scan must reject the version by name
+
+    # --- wire integrity (ISSUE 19; BYTEPS_WIRE_CRC*) -----------------------
+    wire_crc: bool = False                # BYTEPS_WIRE_CRC
+    #   stamp a CRC32C trailer over header + payload on every data-plane
+    #   frame; receivers verify BEFORE the frame touches any dedup /
+    #   engine / accumulator state and drop mismatches exactly like a
+    #   chaos drop (the retry layer resends). Off (default) keeps every
+    #   frame byte-for-byte the pre-CRC wire
+    wire_crc_quarantine: int = 0          # BYTEPS_WIRE_CRC_QUARANTINE
+    #   flaky-link quarantine: CRC failures tolerated per window per
+    #   connection; exceeding it force-closes the connection so the
+    #   reconnect ladder re-dials a fresh socket, and past the reconnect
+    #   budget (BYTEPS_RECONNECT_MAX) the persistently corrupting link
+    #   fail-stops BY NAME. 0 (default) = count/trace only
+    wire_crc_window_ms: int = 10000       # BYTEPS_WIRE_CRC_WINDOW_MS
+    #   the quarantine failure-counting window
 
     # --- chaos injection (deterministic fault harness; BYTEPS_CHAOS_*) -----
     chaos_seed: int = 0                   # BYTEPS_CHAOS_SEED
@@ -295,6 +312,10 @@ class Config:
     #   P(drop) per data-plane frame on the send path (0 disables)
     chaos_dup: float = 0.0                # BYTEPS_CHAOS_DUP
     #   P(duplicate delivery) per data-plane frame
+    chaos_corrupt: float = 0.0            # BYTEPS_CHAOS_CORRUPT
+    #   P(one on-wire payload byte flipped AFTER the CRC trailer is
+    #   stamped) per data-plane frame; requires BYTEPS_WIRE_CRC=1 —
+    #   undetected corruption would be silently summed into the model
     chaos_delay_us: int = 0               # BYTEPS_CHAOS_DELAY_US
     #   fixed extra latency per data-plane frame
     chaos_reset_every: int = 0            # BYTEPS_CHAOS_RESET_EVERY
@@ -536,6 +557,12 @@ class Config:
                 "every frame can never make progress")
         if not (0.0 <= self.chaos_dup < 1.0):
             raise ValueError("BYTEPS_CHAOS_DUP is a probability in [0, 1)")
+        if not (0.0 <= self.chaos_corrupt <= 1.0):
+            # 1.0 IS legal here (unlike drop): corrupting every frame is
+            # the persistent-corruption test — the quarantine ladder must
+            # escalate it to the named fail-stop, not hang.
+            raise ValueError(
+                "BYTEPS_CHAOS_CORRUPT is a probability in [0, 1]")
         if self.chaos_delay_us < 0:
             raise ValueError("BYTEPS_CHAOS_DELAY_US must be >= 0")
         if self.chaos_reset_every < 0:
@@ -543,13 +570,38 @@ class Config:
                 "BYTEPS_CHAOS_RESET_EVERY must be >= 0 (reset the "
                 "connection every N data frames; 0 disables)")
         chaos_on = (self.chaos_drop > 0 or self.chaos_dup > 0
+                    or self.chaos_corrupt > 0
                     or self.chaos_reset_every > 0)
         if chaos_on and self.retry_max == 0:
             raise ValueError(
-                "BYTEPS_CHAOS_DROP/_DUP/_RESET_EVERY inject faults that "
-                "only the retry layer can absorb; they require "
-                "BYTEPS_RETRY_MAX > 0 (the combination would just crash "
-                "the fleet at the first injected fault)")
+                "BYTEPS_CHAOS_DROP/_DUP/_CORRUPT/_RESET_EVERY inject "
+                "faults that only the retry layer can absorb; they "
+                "require BYTEPS_RETRY_MAX > 0 (the combination would "
+                "just crash the fleet at the first injected fault)")
+        if self.chaos_corrupt > 0 and not self.wire_crc:
+            raise ValueError(
+                "BYTEPS_CHAOS_CORRUPT flips on-wire payload bytes; it "
+                "requires BYTEPS_WIRE_CRC=1 — without the CRC trailer "
+                "the corruption goes undetected and is silently summed "
+                "into the model instead of exercising the drop/resend "
+                "path under test")
+        if self.wire_crc_quarantine < 0:
+            raise ValueError(
+                "BYTEPS_WIRE_CRC_QUARANTINE must be >= 0 (CRC failures "
+                "tolerated per window per connection; 0 disables "
+                "quarantine and keeps count/trace-only behavior)")
+        if self.wire_crc_window_ms < 100:
+            raise ValueError(
+                "BYTEPS_WIRE_CRC_WINDOW_MS must be >= 100 (the "
+                "quarantine failure-counting window; sub-100ms windows "
+                "reset faster than a retry round trip, so the threshold "
+                "could never accumulate)")
+        if self.wire_crc_quarantine > 0 and not self.wire_crc:
+            import warnings
+            warnings.warn(
+                "BYTEPS_WIRE_CRC_QUARANTINE is set but BYTEPS_WIRE_CRC "
+                "is off: no frame carries a CRC, so no failure can ever "
+                "be counted and the quarantine never fires", stacklevel=2)
         if self.recovery_timeout_ms < 0:
             raise ValueError(
                 "BYTEPS_RECOVERY_TIMEOUT_MS must be >= 0 (0 disables hot "
@@ -761,11 +813,12 @@ class Config:
                 "checksum-valid manifest, and there is no directory "
                 "to scan")
         if self.chaos_ckpt:
-            if self.chaos_ckpt not in ("truncate", "bitflip"):
+            if self.chaos_ckpt not in ("truncate", "bitflip", "sealflip"):
                 raise ValueError(
                     f"BYTEPS_CHAOS_CKPT ({self.chaos_ckpt!r}) must be "
-                    "'truncate' or 'bitflip' (torn-write injection "
-                    "mode applied to chunk 0 of every spill)")
+                    "'truncate' or 'bitflip' (torn-write injection on a "
+                    "seeded-random chunk of every spill) or 'sealflip' "
+                    "(corrupt the sealed MANIFEST itself)")
             if not self.ckpt_dir:
                 raise ValueError(
                     "BYTEPS_CHAOS_CKPT requires BYTEPS_CKPT_DIR: "
@@ -884,9 +937,14 @@ def load_config() -> Config:
         ckpt_restore=_env_bool("BYTEPS_CKPT_RESTORE"),
         ckpt_lag_warn=_env_int("BYTEPS_CKPT_LAG_WARN", 8),
         chaos_ckpt=_env_str("BYTEPS_CHAOS_CKPT", ""),
+        wire_crc=_env_bool("BYTEPS_WIRE_CRC"),
+        wire_crc_quarantine=_env_int("BYTEPS_WIRE_CRC_QUARANTINE", 0),
+        wire_crc_window_ms=_env_int("BYTEPS_WIRE_CRC_WINDOW_MS", 10000),
         chaos_seed=_env_int("BYTEPS_CHAOS_SEED", 0),
         chaos_drop=float(os.environ.get("BYTEPS_CHAOS_DROP", "0") or 0),
         chaos_dup=float(os.environ.get("BYTEPS_CHAOS_DUP", "0") or 0),
+        chaos_corrupt=float(
+            os.environ.get("BYTEPS_CHAOS_CORRUPT", "0") or 0),
         chaos_delay_us=_env_int("BYTEPS_CHAOS_DELAY_US", 0),
         chaos_reset_every=_env_int("BYTEPS_CHAOS_RESET_EVERY", 0),
         chaos_ctrl=_env_bool("BYTEPS_CHAOS_CTRL"),

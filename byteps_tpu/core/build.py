@@ -18,6 +18,7 @@ LIB_PATH = os.path.join(CORE_DIR, "libbyteps_core.so")
 
 SOURCES = [
     "debug.cc",
+    "crc32c.cc",
     "trace.cc",
     "tenancy.cc",
     "roundstats.cc",

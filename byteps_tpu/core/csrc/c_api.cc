@@ -1270,7 +1270,9 @@ long long bps_snap_probe(const char* script, char* buf,
 // (semicolon-separated op:args):
 //   dir:<path>      checkpoint root for all later ops
 //   rank:<r>        shard rank for all later ops
-//   chaos:<mode>    none | truncate | bitflip (applied by later spills)
+//   chaos:<mode>    none | truncate | bitflip | sealflip (applied by
+//                   later spills; truncate/bitflip corrupt a
+//                   seeded-random chunk, sealflip the sealed MANIFEST)
 //   spill:V,K       spill a synthetic K-key cut as version V; item i is
 //                   16 float32s of value V*1000+i under tenant i%2 —
 //                   deterministic, so load can assert fidelity

@@ -17,38 +17,8 @@
 
 namespace bps {
 
-// --- CRC32C ------------------------------------------------------------------
-
-namespace {
-
-const uint32_t* Crc32cTable() {
-  static uint32_t table[256];
-  static bool init = [] {
-    // Castagnoli polynomial, reflected: 0x82F63B78.
-    for (uint32_t i = 0; i < 256; ++i) {
-      uint32_t c = i;
-      for (int k = 0; k < 8; ++k) {
-        c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
-      }
-      table[i] = c;
-    }
-    return true;
-  }();
-  (void)init;
-  return table;
-}
-
-}  // namespace
-
-uint32_t Crc32c(const void* data, size_t len, uint32_t seed) {
-  const uint32_t* table = Crc32cTable();
-  uint32_t c = seed ^ 0xFFFFFFFFu;
-  const unsigned char* p = static_cast<const unsigned char*>(data);
-  for (size_t i = 0; i < len; ++i) {
-    c = table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
-  }
-  return c ^ 0xFFFFFFFFu;
-}
+// CRC32C lives in crc32c.cc (shared with the van's wire trailer and
+// snapshot serving verification — ISSUE 19); ckpt.h re-exports it.
 
 // --- filesystem helpers ------------------------------------------------------
 
@@ -367,21 +337,31 @@ bool CkptSpillSync(const std::string& dir, int rank, int64_t version,
   char dl[32];
   snprintf(dl, sizeof(dl), "digest %08x\n", digest);
   manifest += dl;
-  // Chaos injection (BYTEPS_CHAOS_CKPT): corrupt chunk 0 AFTER its CRC
-  // was recorded and BEFORE the manifest seals the checkpoint — the
-  // exact torn-write window a crash mid-spill exposes. Scan/load must
-  // reject this checkpoint by name, never install it.
-  if (!chaos.empty() && !cut.empty()) {
-    const std::string c0 = path + "/" + ChunkName(0);
+  // Chaos injection (BYTEPS_CHAOS_CKPT): corrupt a seeded-random chunk
+  // AFTER its CRC was recorded and BEFORE the manifest seals the
+  // checkpoint — the exact torn-write window a crash mid-spill exposes
+  // (chunk 0 alone, the pre-ISSUE-19 target, would never exercise the
+  // scan's per-chunk verification past the first item). Deterministic
+  // per (seed, version) so probe tests can name the victim. Scan/load
+  // must reject this checkpoint by name, never install it.
+  if (!chaos.empty() && !cut.empty() && chaos != "sealflip") {
+    uint64_t z = static_cast<uint64_t>(version) * 0x9E3779B97F4A7C15ull;
+    if (const char* sv = getenv("BYTEPS_CHAOS_SEED")) {
+      z += static_cast<uint64_t>(atoll(sv));
+    }
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    const size_t victim = static_cast<size_t>((z ^ (z >> 31)) % cut.size());
+    const std::string cv = path + "/" + ChunkName(victim);
     if (chaos == "truncate") {
       const long long half =
-          static_cast<long long>(cut[0].entry.raw->size()) / 2;
-      if (truncate(c0.c_str(), half) != 0 && why) {
+          static_cast<long long>(cut[victim].entry.raw->size()) / 2;
+      if (truncate(cv.c_str(), half) != 0 && why) {
         *why += "chaos truncate failed: " + std::string(strerror(errno)) +
                 "; ";
       }
     } else if (chaos == "bitflip") {
-      int fd = open(c0.c_str(), O_RDWR);
+      int fd = open(cv.c_str(), O_RDWR);
       if (fd >= 0) {
         char b = 0;
         if (pread(fd, &b, 1, 0) == 1) {
@@ -392,8 +372,9 @@ bool CkptSpillSync(const std::string& dir, int rank, int64_t version,
         close(fd);
       }
     }
-    BPS_LOG(WARNING) << "ckpt: CHAOS corrupted chunk 0 of version "
-                     << version << " (" << chaos << ") pre-seal";
+    BPS_LOG(WARNING) << "ckpt: CHAOS corrupted chunk " << victim
+                     << " of version " << version << " (" << chaos
+                     << ") pre-seal";
   }
   // The seal covers every manifest byte BEFORE the seal line itself
   // (ParseManifest recomputes over exactly that region).
@@ -404,6 +385,25 @@ bool CkptSpillSync(const std::string& dir, int rank, int64_t version,
   if (!WriteFileAtomic(path, kManifest, manifest.data(), manifest.size(),
                        why)) {
     return false;
+  }
+  // Chaos "sealflip" (ISSUE 19): corrupt the sealed MANIFEST itself —
+  // every chunk is intact, but the manifest's own integrity line no
+  // longer matches its body. The restore scan must reject the version
+  // on the seal check alone, before it ever reads a chunk.
+  if (chaos == "sealflip") {
+    const std::string mf = path + "/" + std::string(kManifest);
+    int fd = open(mf.c_str(), O_RDWR);
+    if (fd >= 0) {
+      char b = 0;
+      if (pread(fd, &b, 1, 0) == 1) {
+        b ^= 0x01;
+        (void)!pwrite(fd, &b, 1, 0);
+        fsync(fd);
+      }
+      close(fd);
+    }
+    BPS_LOG(WARNING) << "ckpt: CHAOS corrupted the MANIFEST seal of "
+                        "version " << version << " (sealflip)";
   }
   // Durability of the renames themselves: fsync the checkpoint dir and
   // its parent so the directory entries survive power loss too.

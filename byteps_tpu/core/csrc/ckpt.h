@@ -31,13 +31,10 @@
 #include <thread>
 #include <vector>
 
+#include "crc32c.h"  // Crc32c — hoisted to the shared utility (ISSUE 19)
 #include "snapshot.h"
 
 namespace bps {
-
-// Software CRC32C (Castagnoli, the iSCSI/ext4 polynomial). Table-driven;
-// plenty for checkpoint freight (the fsyncs dominate, not the checksum).
-uint32_t Crc32c(const void* data, size_t len, uint32_t seed = 0);
 
 // One key's restored value (CkptLoad output).
 struct CkptItem {
@@ -52,10 +49,12 @@ struct CkptItem {
 // --- synchronous core (shared by the writer thread and the probe) -----------
 
 // Persist one complete cut as checkpoint `version` for server shard
-// `rank` under `dir`. `chaos` ("" / "truncate" / "bitflip") corrupts
-// chunk 0 AFTER its CRC was recorded and BEFORE the manifest seals the
-// checkpoint — the torn-write injection the rejection tests drive
-// (BYTEPS_CHAOS_CKPT). Returns false with a diagnostic in *why.
+// `rank` under `dir`. `chaos` ("" / "truncate" / "bitflip" /
+// "sealflip") is the torn-write injection the rejection tests drive
+// (BYTEPS_CHAOS_CKPT): truncate/bitflip corrupt a seeded-random chunk
+// AFTER its CRC was recorded and BEFORE the manifest seals the
+// checkpoint; sealflip corrupts the sealed MANIFEST itself (intact
+// chunks, broken seal). Returns false with a diagnostic in *why.
 bool CkptSpillSync(const std::string& dir, int rank, int64_t version,
                    const std::vector<SnapDeltaEnt>& cut, int num_workers,
                    int num_servers, const std::string& chaos,

@@ -261,6 +261,18 @@ enum MsgFlags : int32_t {
                              // fail-stops rather than cold-start). The
                              // committed fleet restore epoch rides back
                              // the same way in CMD_ADDRBOOK's key.
+  FLAG_WIRE_CRC = 1 << 4,    // BYTEPS_WIRE_CRC frame integrity (ISSUE
+                             // 19): the payload carries a 4-byte
+                             // little-endian CRC32C trailer computed
+                             // over the MsgHeader (as stamped, flag set,
+                             // payload_len INCLUDING the trailer, the
+                             // trailer field itself excluded) followed
+                             // by the payload bytes. payload_len counts
+                             // the trailer, so framing is unchanged;
+                             // receivers verify, then strip the trailer
+                             // and clear this flag before dispatch. A
+                             // CRC-off frame carries no trailer and no
+                             // flag — byte-for-byte the pre-CRC wire.
 };
 
 // --- wire header ------------------------------------------------------------

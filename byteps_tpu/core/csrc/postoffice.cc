@@ -83,6 +83,15 @@ bool SchedRecoveryEnabled() {
   return on;
 }
 
+// Human name for a node id under the fixed id layout (scheduler 0,
+// servers 1..S, workers S+1..): failure messages must NAME the link
+// ("persistently corrupting link worker3→server1"), not print raw ids.
+static std::string NodeName(int node_id, int num_servers) {
+  if (node_id == kSchedulerId) return "scheduler";
+  if (node_id <= num_servers) return "server" + std::to_string(node_id - 1);
+  return "worker" + std::to_string(node_id - 1 - num_servers);
+}
+
 int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
                       int num_workers, int num_servers,
                       AppHandler app_handler) {
@@ -124,6 +133,25 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
       }
     }
     if (node_id < 0) return;
+    // Persistently corrupting link (ISSUE 19): the corruption handler
+    // below already burned the full reconnect budget on CRC-quarantine
+    // re-dials and branded this peer — every fresh socket corrupted
+    // again. Skip the reconnect ladder AND the recovery park (both
+    // would just hide a deterministic fault) and fail the peer by name:
+    // the KV layer errors its outstanding requests, the worker raises,
+    // the process exits nonzero. A fail-stop, not a hang.
+    {
+      bool corrupt_failed;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        corrupt_failed = corrupt_failed_.count(node_id) > 0;
+      }
+      if (corrupt_failed) {
+        Trace::Get().Note("PEER_LOST", 0, node_id);
+        if (peer_lost_cb_) peer_lost_cb_(node_id);
+        return;
+      }
+    }
     // Scheduler fail-over (ISSUE 15): with it armed, a lost scheduler
     // connection is NOT escalated here — the heartbeat thread owns the
     // park (its next beat fails on the dead fd and enters
@@ -195,6 +223,59 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
     }
     Trace::Get().Note("PEER_LOST", 0, node_id);
     if (peer_lost_cb_) peer_lost_cb_(node_id);
+  });
+  // Flaky-link quarantine attribution (ISSUE 19): the van tripped the
+  // windowed CRC-failure threshold on a connection and is about to
+  // force-close it (the disconnect handler above then re-dials through
+  // the normal reconnect ladder — a fresh socket clears a genuinely
+  // flaky path). Here we map the fd back to its peer, count the trip,
+  // and past the reconnect budget brand the link persistently
+  // corrupting so the imminent disconnect escalates to the named
+  // fail-stop instead of burning another ladder on a poisoned path.
+  van_->SetCorruptionHandler([this](int fd) {
+    if (shutting_down_.load()) return;
+    int node_id = -1;
+    int count = 0;
+    bool failed = false;
+    const int budget = static_cast<int>(EnvLong("BYTEPS_RECONNECT_MAX", 3));
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (const auto& kv : node_fd_) {
+        if (kv.second == fd) { node_id = kv.first; break; }
+      }
+      if (node_id < 0) {
+        for (const auto& kv : node_extra_fds_) {
+          for (int efd : kv.second) {
+            if (efd == fd) { node_id = kv.first; break; }
+          }
+          if (node_id >= 0) break;
+        }
+      }
+      if (node_id < 0) return;
+      count = ++corrupt_quarantines_[node_id];
+      if (count > budget && corrupt_failed_.insert(node_id).second) {
+        failed = true;
+      }
+    }
+    const std::string link =
+        NodeName(node_id, num_servers_) + "->" +
+        NodeName(my_id_, num_servers_);
+    BPS_METRIC_COUNTER_ADD("bps_crc_quarantine_links_total", 1);
+    if (failed) {
+      BPS_METRIC_GAUGE_SET("bps_link_corrupting", 1);
+      BPS_LOG(WARNING) << "node " << my_id_
+                     << ": persistently corrupting link " << link
+                     << " — CRC quarantine tripped " << count
+                     << "x, past the reconnect budget (" << budget
+                     << "); failing the peer (fail-stop)";
+      Trace::Get().Note("LINK_CORRUPTING", count, node_id);
+      Trace::Get().FlightDumpAuto("corrupting_link");
+    } else {
+      BPS_LOG(WARNING) << "node " << my_id_ << ": CRC quarantine #"
+                       << count << " on link " << link
+                       << " — forcing a re-dial through a fresh socket";
+      Trace::Get().Note("LINK_QUARANTINED", count, node_id);
+    }
   });
 
   // Fleet-formation bound: until the topology completes no job can be

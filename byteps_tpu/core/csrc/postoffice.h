@@ -379,6 +379,17 @@ class Postoffice {
     int64_t deadline_ms = 0;
   };
   std::unordered_map<int, DiscPark> disc_parked_;
+  // Wire-CRC flaky-link quarantine attribution (ISSUE 19, guarded by
+  // mu_): per-peer count of quarantine trips (the van force-closed a
+  // connection over windowed CRC failures, BYTEPS_WIRE_CRC_QUARANTINE).
+  // A peer whose trip count exceeds the reconnect budget
+  // (BYTEPS_RECONNECT_MAX) is a persistently corrupting link: it joins
+  // corrupt_failed_, and the disconnect handler then escalates straight
+  // to the named fail-stop instead of re-dialing a poisoned path (a
+  // fresh socket has already been tried budget-many times; the
+  // corruption followed it every time).
+  std::unordered_map<int, int> corrupt_quarantines_;
+  std::unordered_set<int> corrupt_failed_;
   // scheduler only: the rank being replaced (-1 = none) and the
   // fall-back-to-fail-stop deadline for the replacement to arrive.
   int recovering_node_ = -1;

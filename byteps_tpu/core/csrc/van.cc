@@ -19,6 +19,7 @@
 #endif
 
 #include <cerrno>
+#include <chrono>
 #include <climits>
 #include <cstdio>
 #include <cstdlib>
@@ -30,6 +31,7 @@
 #define IOV_MAX 1024
 #endif
 
+#include "crc32c.h"
 #include "logging.h"
 #include "metrics.h"
 #include "shm_ring.h"
@@ -78,6 +80,10 @@ struct ChaosCfg {
   uint64_t seed = 0;
   double drop = 0.0;       // P(frame silently not written)
   double dup = 0.0;        // P(frame written twice back-to-back)
+  double corrupt = 0.0;    // P(one on-wire payload byte flipped AFTER the
+                           // wire CRC was stamped — ISSUE 19's bitflip
+                           // window; config.py requires BYTEPS_WIRE_CRC
+                           // so the flip is detected, not summed in)
   int64_t delay_us = 0;    // fixed extra latency per data frame
   int64_t reset_every = 0; // force a connection reset every N data frames
 };
@@ -95,11 +101,13 @@ static const ChaosCfg& Chaos() {
     };
     c.drop = envf("BYTEPS_CHAOS_DROP");
     c.dup = envf("BYTEPS_CHAOS_DUP");
+    c.corrupt = envf("BYTEPS_CHAOS_CORRUPT");
     c.delay_us = envll("BYTEPS_CHAOS_DELAY_US");
     c.reset_every = envll("BYTEPS_CHAOS_RESET_EVERY");
     c.seed = static_cast<uint64_t>(envll("BYTEPS_CHAOS_SEED"));
     c.ctrl = envll("BYTEPS_CHAOS_CTRL") != 0;
-    c.on = c.drop > 0 || c.dup > 0 || c.delay_us > 0 || c.reset_every > 0;
+    c.on = c.drop > 0 || c.dup > 0 || c.corrupt > 0 || c.delay_us > 0 ||
+           c.reset_every > 0;
     return c;
   }();
   return cfg;
@@ -113,6 +121,44 @@ static double ChaosRand(uint64_t* state) {
   z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
   z ^= z >> 31;
   return static_cast<double>(z >> 11) * (1.0 / 9007199254740992.0);
+}
+
+// --- wire-CRC frame integrity (BYTEPS_WIRE_CRC, ISSUE 19) -------------------
+// When armed, every data-plane frame carries a 4-byte little-endian
+// CRC32C trailer (see FLAG_WIRE_CRC in common.h for the exact layout
+// contract). Off by default and byte-for-byte the pre-CRC wire when off:
+// no trailer, no flag, zero per-send cost beyond one cached-bool branch.
+static bool WireCrcEnabled() {
+  static const bool on = [] {
+    const char* v = getenv("BYTEPS_WIRE_CRC");
+    return v && *v && *v != '0';
+  }();
+  return on;
+}
+
+// Quarantine threshold: CRC failures tolerated per window per connection
+// before the van force-closes it so the reconnect ladder re-dials a
+// fresh socket (flaky-link quarantine). 0 = count/trace only.
+static int64_t WireCrcQuarantine() {
+  static const int64_t n = [] {
+    const char* v = getenv("BYTEPS_WIRE_CRC_QUARANTINE");
+    return v && *v ? atoll(v) : 0ll;
+  }();
+  return n;
+}
+
+static int64_t WireCrcWindowUs() {
+  static const int64_t us = [] {
+    const char* v = getenv("BYTEPS_WIRE_CRC_WINDOW_MS");
+    return (v && *v ? atoll(v) : 10000ll) * 1000;
+  }();
+  return us;
+}
+
+static int64_t RxNowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
 }
 
 // Size data-connection socket buffers for high-bandwidth-delay links
@@ -463,9 +509,36 @@ bool Van::SendV(int fd, const MsgHeader& head, const struct iovec* segs,
   // send lock (so seq order == wire order). A chaos-duplicated frame
   // carries the SAME seq — it is the same frame delivered twice.
   if (tx) h.seq = ++tx->seq;
+  // Wire-CRC trailer (data-plane frames only; control traffic keeps the
+  // bare wire so CRC-on fleets interoperate frame-layout-wise with the
+  // handshake path). Stamped AFTER the seq so the CRC covers the final
+  // header exactly as it hits the wire. The trailer rides as one extra
+  // iovec segment: payload bytes stay zero-copy.
+  uint32_t crc_trailer = 0;
+  std::vector<iovec> crc_segs;
+  if (WireCrcEnabled() && IsDataPlaneCmd(h.cmd)) {
+    h.flags |= FLAG_WIRE_CRC;
+    h.payload_len = payload_len + 4;
+    total += 4;
+    uint32_t c = Crc32c(&h, sizeof(h));
+    for (int i = 0; i < nsegs; ++i) {
+      if (segs[i].iov_len) c = Crc32c(segs[i].iov_base, segs[i].iov_len, c);
+    }
+    crc_trailer = c;
+    crc_segs.assign(segs, segs + nsegs);
+    iovec t;
+    t.iov_base = &crc_trailer;
+    t.iov_len = sizeof(crc_trailer);
+    crc_segs.push_back(t);
+    segs = crc_segs.data();
+    nsegs = static_cast<int>(crc_segs.size());
+    payload_len += 4;
+  }
   // Chaos injection point (data-plane frames, plus control-plane with
   // BYTEPS_CHAOS_CTRL=1; see Chaos()).
   int sends = 1;
+  std::vector<char> corrupt_scratch;
+  iovec corrupt_seg;
   if (tx && Chaos().on && (IsDataPlaneCmd(h.cmd) || Chaos().ctrl)) {
     const ChaosCfg& c = Chaos();
     ++tx->data_frames;
@@ -506,6 +579,40 @@ bool Van::SendV(int fd, const MsgHeader& head, const struct iovec* segs,
       BPS_METRIC_COUNTER_ADD("bps_chaos_dup_total", 1);
       Trace::Get().Note("CHAOS_DUP", h.key, -1, h.req_id);
       sends = 2;  // duplicate delivery, back-to-back, same seq
+    }
+    if (c.corrupt > 0 && payload_len > 0 &&
+        ChaosRand(&tx->rng) < c.corrupt) {
+      // On-wire bit corruption: flip one payload byte AFTER the CRC was
+      // stamped, so the receiver's verify catches it and the retry layer
+      // must resend. The flip happens on a flattened scratch copy — the
+      // caller's iovec buffers are zero-copy views of live engine/fusion
+      // state and the eventual RETRY must ship the uncorrupted bytes.
+      BPS_METRIC_COUNTER_ADD("bps_chaos_injected_total", 1);
+      BPS_METRIC_COUNTER_ADD("bps_chaos_corrupt_total", 1);
+      Trace::Get().Note("CHAOS_CORRUPT", h.key, -1, h.req_id);
+      corrupt_scratch.resize(static_cast<size_t>(payload_len));
+      size_t off = 0;
+      for (int i = 0; i < nsegs; ++i) {
+        if (segs[i].iov_len) {
+          memcpy(corrupt_scratch.data() + off, segs[i].iov_base,
+                 segs[i].iov_len);
+          off += segs[i].iov_len;
+        }
+      }
+      size_t idx = static_cast<size_t>(
+          ChaosRand(&tx->rng) * static_cast<double>(payload_len));
+      if (idx >= static_cast<size_t>(payload_len)) {
+        idx = static_cast<size_t>(payload_len) - 1;
+      }
+      corrupt_scratch[idx] ^= 0x20;
+      if (VerboseLevel() >= 2) {
+        fprintf(stderr, "[PS_VERBOSE] van CHAOS corrupt fd=%d cmd=%d "
+                "seq=%lld byte=%zu\n", fd, h.cmd, (long long)h.seq, idx);
+      }
+      corrupt_seg.iov_base = corrupt_scratch.data();
+      corrupt_seg.iov_len = corrupt_scratch.size();
+      segs = &corrupt_seg;
+      nsegs = 1;
     }
   }
   // Wire instant (main ring only; one per logical send, not per chaos
@@ -704,24 +811,84 @@ static bool ReadFrame(ReadFn&& rd, Message* msg) {
   return true;
 }
 
-void Van::DispatchFrame(Message&& msg, int fd, int64_t* last_seq) {
+void Van::DispatchFrame(Message&& msg, int fd, RxState* rx) {
   int64_t plen = msg.head.payload_len;
   bytes_recv_.fetch_add(
       static_cast<int64_t>(sizeof(uint64_t) + sizeof(MsgHeader) + plen),
       std::memory_order_relaxed);
   BPS_METRIC_COUNTER_ADD("bps_van_recv_frames_total", 1);
+  // Wire-CRC verification (FLAG_WIRE_CRC, ISSUE 19) — BEFORE the seq
+  // cursor and BEFORE any upper layer sees the frame, so a corrupted
+  // frame cannot advance dedup/engine/accumulator state. The CRC covers
+  // the header verbatim as received (the sender stamped it over the
+  // final header, flag set, payload_len including the trailer) chained
+  // over the payload minus the 4-byte trailer. A mismatch is dropped
+  // exactly like a chaos drop: the retry layer's timeout resends.
+  if (msg.head.flags & FLAG_WIRE_CRC) {
+    uint32_t want = 0;
+    bool ok = plen >= 4;
+    if (ok) {
+      memcpy(&want, msg.payload.data() + plen - 4, sizeof(want));
+      uint32_t got = Crc32c(&msg.head, sizeof(MsgHeader));
+      if (plen > 4) {
+        got = Crc32c(msg.payload.data(), static_cast<size_t>(plen) - 4,
+                     got);
+      }
+      ok = got == want;
+    }
+    if (!ok) {
+      BPS_METRIC_COUNTER_ADD("bps_crc_fail_total", 1);
+      Trace::Get().Note("CRC_FAIL", msg.head.key, msg.head.sender,
+                        msg.head.req_id);
+      if (VerboseLevel() >= 1) {
+        fprintf(stderr, "[PS_VERBOSE] van CRC FAIL fd=%d cmd=%d "
+                "sender=%d seq=%lld len=%lld (frame dropped)\n",
+                fd, msg.head.cmd, msg.head.sender, (long long)msg.head.seq,
+                (long long)plen);
+      }
+      // Flaky-link quarantine: too many failures inside one window and
+      // the connection itself is suspect — force-close it so the
+      // reconnect ladder re-dials a fresh socket (postoffice is told
+      // first, via corrupt_cb_, so it can attribute the link to a peer
+      // and escalate persistent corruption to a named fail-stop).
+      if (rx && WireCrcQuarantine() > 0) {
+        int64_t now = RxNowUs();
+        if (rx->win_start_us == 0 ||
+            now - rx->win_start_us > WireCrcWindowUs()) {
+          rx->win_start_us = now;
+          rx->win_fails = 0;
+        }
+        if (++rx->win_fails >= WireCrcQuarantine()) {
+          rx->win_fails = 0;
+          rx->win_start_us = 0;
+          BPS_METRIC_COUNTER_ADD("bps_crc_quarantine_total", 1);
+          Trace::Get().Note("CRC_QUARANTINE", msg.head.key,
+                            msg.head.sender, msg.head.req_id);
+          if (corrupt_cb_ && !stop_.load()) corrupt_cb_(fd);
+          ::shutdown(fd, SHUT_RDWR);
+        }
+      }
+      return;  // dropped: no cursor advance, no dispatch
+    }
+    // Verified: strip the trailer and the flag so upper layers (and the
+    // dedup/fusion parsers) see exactly the pre-CRC frame.
+    plen -= 4;
+    msg.head.payload_len = plen;
+    msg.head.flags &= ~FLAG_WIRE_CRC;
+    msg.payload.resize_uninit(static_cast<size_t>(plen));
+  }
   // Frame-loss observability from the per-connection seq: a jump means
   // frames vanished between sender stamping and this reader (chaos
   // drop); a repeat is a duplicate delivery. Cursor is the single recv
   // thread's local, so no locking.
-  if (msg.head.seq > 0 && last_seq) {
-    if (msg.head.seq == *last_seq) {
+  if (msg.head.seq > 0 && rx) {
+    if (msg.head.seq == rx->last_seq) {
       BPS_METRIC_COUNTER_ADD("bps_seq_dups_total", 1);
-    } else if (*last_seq > 0 && msg.head.seq > *last_seq + 1) {
+    } else if (rx->last_seq > 0 && msg.head.seq > rx->last_seq + 1) {
       BPS_METRIC_COUNTER_ADD("bps_seq_gaps_total",
-                             msg.head.seq - *last_seq - 1);
+                             msg.head.seq - rx->last_seq - 1);
     }
-    if (msg.head.seq > *last_seq) *last_seq = msg.head.seq;
+    if (msg.head.seq > rx->last_seq) rx->last_seq = msg.head.seq;
   }
   LogMsg("recv", fd, msg.head, plen);
   if (Trace::Get().MainOn()) {
@@ -739,13 +906,13 @@ void Van::DispatchFrame(Message&& msg, int fd, int64_t* last_seq) {
 }
 
 void Van::RecvLoop(int fd) {
-  int64_t last_seq = 0;
+  RxState rx;
   while (!stop_.load()) {
     Message msg;
     if (!ReadFrame([fd](void* b, size_t n) { return RecvAll(fd, b, n); },
                    &msg))
       break;
-    DispatchFrame(std::move(msg), fd, &last_seq);
+    DispatchFrame(std::move(msg), fd, &rx);
   }
   // A live-van exit means the PEER went away (EOF / reset), not Stop():
   // let the upper layer fail that peer's outstanding requests now.
@@ -919,7 +1086,7 @@ void Van::AttachShm(int fd, const Message& hello) {
 // notification, and the fd itself closes when its last user thread
 // (this loop or the TCP recv thread via CloseConn) releases it.
 void Van::ShmRecvLoop(int fd, std::shared_ptr<ShmConn> conn) {
-  int64_t last_seq = 0;
+  RxState rx;
   while (!stop_.load()) {
     Message msg;
     if (!ReadFrame(
@@ -929,7 +1096,7 @@ void Van::ShmRecvLoop(int fd, std::shared_ptr<ShmConn> conn) {
             },
             &msg))
       break;
-    DispatchFrame(std::move(msg), fd, &last_seq);
+    DispatchFrame(std::move(msg), fd, &rx);
   }
   if (conn->fd_users.fetch_sub(1) == 1) ::close(fd);
 }

@@ -77,6 +77,17 @@ class Van {
     disconnect_cb_ = std::move(cb);
   }
 
+  // Invoked (on the connection's receive thread) when the wire-CRC
+  // quarantine threshold trips on a connection (ISSUE 19,
+  // BYTEPS_WIRE_CRC_QUARANTINE: too many CRC failures inside one
+  // window) — immediately BEFORE the van force-closes the connection so
+  // the reconnect ladder re-dials a fresh socket. Upper layers use it
+  // to attribute the corrupting link to a peer node and escalate a
+  // persistently-corrupting link to a named fail-stop.
+  void SetCorruptionHandler(std::function<void(int fd)> cb) {
+    corrupt_cb_ = std::move(cb);
+  }
+
   // Cumulative wire bytes (frames + payloads), for bandwidth assertions
   // and the timeline. Monotonic over the van's lifetime.
   int64_t bytes_sent() const { return bytes_sent_.load(); }
@@ -99,6 +110,15 @@ class Van {
     uint64_t rng = 0;
     int64_t data_frames = 0;
   };
+  // Per-connection receive state, owned by the connection's single frame
+  // consumer thread per transport (no locking): the seq gap/dup cursor
+  // plus the wire-CRC quarantine window (BYTEPS_WIRE_CRC_QUARANTINE,
+  // ISSUE 19; van.cc).
+  struct RxState {
+    int64_t last_seq = 0;
+    int64_t win_fails = 0;     // CRC failures inside the current window
+    int64_t win_start_us = 0;  // window open time (0 = none open yet)
+  };
 
   // One framed write on an already-locked connection (transport
   // selection: shm ring / zerocopy / gather writev). Factored out of
@@ -114,11 +134,13 @@ class Van {
   std::shared_ptr<std::mutex> StartRecvThread(int fd);
   void ShmRecvLoop(int fd, std::shared_ptr<ShmConn> conn);
   // Shared tail of both recv loops: wire accounting, PS_VERBOSE trace,
-  // seq gap/dup detection, van-internal command handling, handler
-  // dispatch — ONE copy so the transports cannot drift. `last_seq` is
-  // the caller recv loop's per-connection cursor (each connection has
+  // wire-CRC verification (BYTEPS_WIRE_CRC — a mismatching frame is
+  // dropped here, before it can touch seq cursors or upper-layer
+  // state), seq gap/dup detection, van-internal command handling,
+  // handler dispatch — ONE copy so the transports cannot drift. `rx` is
+  // the caller recv loop's per-connection state (each connection has
   // exactly one frame consumer thread per transport).
-  void DispatchFrame(Message&& msg, int fd, int64_t* last_seq);
+  void DispatchFrame(Message&& msg, int fd, RxState* rx);
   // Connector side; returns false -> stay on TCP. `smu` is the send-mutex
   // identity StartRecvThread returned for this connection.
   bool OfferShm(int fd, const std::shared_ptr<std::mutex>& smu);
@@ -126,6 +148,7 @@ class Van {
 
   Handler handler_;
   std::function<void(int fd)> disconnect_cb_;
+  std::function<void(int fd)> corrupt_cb_;
   std::atomic<int> listen_fd_{-1};
   std::atomic<bool> stop_{false};
   std::atomic<int64_t> bytes_sent_{0};

@@ -541,9 +541,15 @@ def _apply_config_env(cfg: Optional[Config]) -> None:
     os.environ["BYTEPS_CHAOS_SEED"] = str(cfg.chaos_seed)
     os.environ["BYTEPS_CHAOS_DROP"] = str(cfg.chaos_drop)
     os.environ["BYTEPS_CHAOS_DUP"] = str(cfg.chaos_dup)
+    os.environ["BYTEPS_CHAOS_CORRUPT"] = str(cfg.chaos_corrupt)
     os.environ["BYTEPS_CHAOS_DELAY_US"] = str(cfg.chaos_delay_us)
     os.environ["BYTEPS_CHAOS_RESET_EVERY"] = str(cfg.chaos_reset_every)
     os.environ["BYTEPS_CHAOS_CTRL"] = "1" if cfg.chaos_ctrl else "0"
+    # Wire integrity (ISSUE 19): every role reads these — senders stamp
+    # the CRC trailer, receivers verify and run the quarantine window.
+    os.environ["BYTEPS_WIRE_CRC"] = "1" if cfg.wire_crc else "0"
+    os.environ["BYTEPS_WIRE_CRC_QUARANTINE"] = str(cfg.wire_crc_quarantine)
+    os.environ["BYTEPS_WIRE_CRC_WINDOW_MS"] = str(cfg.wire_crc_window_ms)
 
 
 class _Node:

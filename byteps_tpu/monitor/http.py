@@ -104,6 +104,23 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     "retries": int(counters.get("bps_retries_total", 0)),
                     "reconnects": int(
                         counters.get("bps_reconnects_total", 0)),
+                    # Wire integrity (ISSUE 19): sequence-cursor frame
+                    # accounting (gaps = frames lost between stamping
+                    # and this receiver, dups = duplicate deliveries)
+                    # plus the CRC data plane — failed verifications,
+                    # quarantine trips (flaky-link force-re-dials), and
+                    # the persistently-corrupting-link flag that
+                    # precedes the named fail-stop.
+                    "seq_gaps": int(
+                        counters.get("bps_seq_gaps_total", 0)),
+                    "seq_dups": int(
+                        counters.get("bps_seq_dups_total", 0)),
+                    "crc_fails": int(
+                        counters.get("bps_crc_fail_total", 0)),
+                    "crc_quarantines": int(
+                        counters.get("bps_crc_quarantine_total", 0)),
+                    "corrupting": bool(
+                        gauges.get("bps_link_corrupting", 0)),
                     # Hot-replacement telemetry: completed recoveries and
                     # the fleet membership epoch (bumped per recovery).
                     "recoveries": int(

@@ -81,7 +81,7 @@ REGRESS_FACTOR = 1.5
 REGRESS_MIN_UPDATES = 3
 
 FLEET_STATES = ("healthy", "wire-bound", "sum-bound", "straggler-skewed",
-                "retry-degraded", "resizing")
+                "retry-degraded", "corruption-degraded", "resizing")
 
 
 def stage_breakdown(rec: dict) -> Dict[str, float]:
@@ -135,14 +135,18 @@ def classify(workers: Dict[str, dict], straggler_factor: float = 2.0,
              retry_threshold: int = 1,
              dominance: float = DOMINANCE_SHARE,
              resizing: bool = False,
-             tenants: Optional[Dict[str, int]] = None) -> dict:
+             tenants: Optional[Dict[str, int]] = None,
+             crc_fails: int = 0) -> dict:
     """Fleet state from per-worker round records (one record per
     worker — normally each rank's latest completed round).
 
     Precedence: a membership epoch change in flight (``resizing``)
     first — a round spanning a join/leave/shrink legitimately stalls
     some ranks behind the commit and would otherwise read as
-    straggler-skewed — then faults (``retry-degraded``), then skew
+    straggler-skewed — then wire corruption (``corruption-degraded``,
+    driven by the caller-scraped ``crc_fails`` total: CRC-failed frames
+    CAUSE the resends, so naming the corruption outranks the generic
+    retry state), then faults (``retry-degraded``), then skew
     (``straggler-skewed``), then stage dominance (``wire-bound`` /
     ``sum-bound``); anything else is ``healthy``. Skew outranks
     dominance because a paced straggler ALSO inflates wire shares —
@@ -173,6 +177,8 @@ def classify(workers: Dict[str, dict], straggler_factor: float = 2.0,
 
     if resizing:
         state = "resizing"
+    elif crc_fails > 0:
+        state = "corruption-degraded"
     elif retries >= retry_threshold:
         state = "retry-degraded"
     elif stragglers:
@@ -262,6 +268,13 @@ def hints(state: str, fleet_rec: dict) -> List[str]:
             "resends are burning round time -> inspect link loss; if "
             "rounds are healthy-but-slow, raise BYTEPS_RETRY_TIMEOUT_MS "
             "so the timer stops re-sending live requests")
+    elif state == "corruption-degraded":
+        out.append(
+            "frames are failing CRC32C verification (bps_crc_fail_total "
+            "climbing) -> the wire is corrupting data, not just losing "
+            "it; check NICs/cables on the flagged link, arm "
+            "BYTEPS_WIRE_CRC_QUARANTINE to force re-dials, and expect a "
+            "named fail-stop if the corruption survives fresh sockets")
     elif state == "resizing":
         out.append(
             "a worker membership epoch change is committing -> "

@@ -211,6 +211,18 @@ def analyze(scrapes: Dict[str, Optional[dict]],
             # BEFORE the node goes dead.
             "retries": int(_sample(m, "bps_retries_total")),
             "reconnects": int(_sample(m, "bps_reconnects_total")),
+            # Wire integrity (ISSUE 19): receive-side frame accounting.
+            # gaps/dups come from the per-connection seq cursor; CRC
+            # fails are frames dropped on a checksum mismatch;
+            # quarantines are flaky-link force-re-dials; corrupting is
+            # the persistently-corrupting-link flag that precedes the
+            # named fail-stop.
+            "seq_gaps": int(_sample(m, "bps_seq_gaps_total")),
+            "seq_dups": int(_sample(m, "bps_seq_dups_total")),
+            "crc_fails": int(_sample(m, "bps_crc_fail_total")),
+            "crc_quarantines": int(
+                _sample(m, "bps_crc_quarantine_total")),
+            "corrupting": bool(_sample(m, "bps_link_corrupting")),
             # Hot-replacement telemetry: server recoveries this worker
             # re-seeded, and whether one is in progress right now.
             "recoveries": int(_sample(m, "bps_recoveries_total")),
@@ -273,6 +285,8 @@ def analyze(scrapes: Dict[str, Optional[dict]],
     retrying = sorted((n for n, w in workers.items()
                        if w["retries"] > 0 or w["reconnects"] > 0),
                       key=_rank_key)
+    corrupting = sorted((n for n, w in workers.items()
+                         if w["corrupting"]), key=_rank_key)
     trace_dropping = sorted((n for n, w in workers.items()
                              if w["trace_dropped"] > 0),
                             key=_rank_key)
@@ -338,7 +352,9 @@ def analyze(scrapes: Dict[str, Optional[dict]],
         from byteps_tpu.monitor import insight
         rep = insight.classify(round_recs,
                                straggler_factor=straggler_factor,
-                               resizing=resizing)
+                               resizing=resizing,
+                               crc_fails=sum(w["crc_fails"]
+                                             for w in workers.values()))
         fleet_state = rep["state"]
         fleet_bottleneck = rep["dominant"]
     elif resizing:
@@ -376,6 +392,10 @@ def analyze(scrapes: Dict[str, Optional[dict]],
         "baseline_push_us": baseline_us,
         "stragglers": sorted(stragglers, key=_rank_key),
         "retrying": retrying,
+        # Wire integrity (ISSUE 19): workers observing a persistently
+        # corrupting link (bps_link_corrupting set — the named
+        # fail-stop is imminent or already under way).
+        "corrupting": corrupting,
         "trace_dropping": trace_dropping,
         "stale_nodes": sorted(stale_nodes),
         "dead_nodes": sorted(dead_nodes),
@@ -407,7 +427,8 @@ def _print_report(report: dict, as_json: bool) -> None:
         return
     print(f"{'worker':<10} {'push/s':>8} {'push MB':>9} {'pull MB':>9} "
           f"{'q-ratio':>7} {'mean push':>10} {'queue':>6} {'credit':>14} "
-          f"{'rtry':>5} {'reconn':>6} {'BOTTLENECK':>14} flags")
+          f"{'rtry':>5} {'reconn':>6} {'gap/dup':>8} {'crc':>5} "
+          f"{'BOTTLENECK':>14} flags")
     if report.get("fleet_workers"):
         extra = ""
         if report.get("joins") or report.get("leaves"):
@@ -460,10 +481,13 @@ def _print_report(report: dict, as_json: bool) -> None:
             flags.append("RECOVERING")
         elif w.get("recoveries"):
             flags.append(f"RECOVERED×{w['recoveries']}")
+        if w.get("corrupting"):
+            flags.append("CORRUPTING")
         credit = (f"{w['inflight_bytes'] >> 10}/"
                   f"{w['credit_budget_bytes'] >> 10}K")
         qratio = (f"{w['quant_ratio']:.1f}x"
                   if w.get("quant_wire_bytes") else "-")
+        gapdup = f"{w.get('seq_gaps', 0)}/{w.get('seq_dups', 0)}"
         bneck = w.get("bottleneck", "-")
         if bneck != "-":
             bneck = f"{bneck}({w.get('bottleneck_share', 0) * 100:.0f}%)"
@@ -472,7 +496,8 @@ def _print_report(report: dict, as_json: bool) -> None:
               f"{qratio:>7} "
               f"{w['push_mean_us'] / 1e3:>8.2f}ms {w['queue_pending']:>6} "
               f"{credit:>14} {w.get('retries', 0):>5} "
-              f"{w.get('reconnects', 0):>6} {bneck:>14} "
+              f"{w.get('reconnects', 0):>6} {gapdup:>8} "
+              f"{w.get('crc_fails', 0):>5} {bneck:>14} "
               f"{' '.join(flags)}")
     replicas = report.get("replicas") or {}
     if replicas:
@@ -493,8 +518,9 @@ def _print_report(report: dict, as_json: bool) -> None:
             print(f"{name:<10} {r['ckpt_version']:>9} "
                   f"{r['lag_rounds']:>5} {r['spills']:>7} "
                   f"{r['failures']:>5} {r['spill_ms']:>8} {flags}")
-    for kind in ("retrying", "stale_nodes", "dead_nodes", "unreachable",
-                 "starved_tenants", "lagging_replicas", "lagging_ckpt"):
+    for kind in ("retrying", "corrupting", "stale_nodes", "dead_nodes",
+                 "unreachable", "starved_tenants", "lagging_replicas",
+                 "lagging_ckpt"):
         if report.get(kind):
             print(f"{kind}: {report[kind]}")
 

@@ -790,7 +790,8 @@ def main() -> int:
                 chaos_armed = any(
                     float(os.environ.get(v, "0") or 0) > 0
                     for v in ("BYTEPS_CHAOS_DROP", "BYTEPS_CHAOS_DUP",
-                              "BYTEPS_CHAOS_RESET_EVERY"))
+                              "BYTEPS_CHAOS_RESET_EVERY",
+                              "BYTEPS_CHAOS_CORRUPT"))
                 ns = int(os.environ["DMLC_NUM_SERVER"])
 
                 def scrape(port):
@@ -822,6 +823,9 @@ def main() -> int:
                 "retries": snap.get("bps_retries_total", 0),
                 "chaos_injected": snap.get("bps_chaos_injected_total",
                                            0),
+                # Wire integrity (ISSUE 19) composition evidence: CRC
+                # verification failures this rank detected itself.
+                "crc_fails": snap.get("bps_crc_fail_total", 0),
                 "parity": parity,
                 # Round-insight composition evidence (ISSUE 7).
                 "rounds_completed": snap.get(
@@ -882,6 +886,15 @@ def main() -> int:
                 "chaos_drop": snap.get("bps_chaos_drop_total", 0),
                 "chaos_dup": snap.get("bps_chaos_dup_total", 0),
                 "chaos_reset": snap.get("bps_chaos_reset_total", 0),
+                # Wire integrity (ISSUE 19): this rank's own receive-side
+                # CRC accounting. Under BYTEPS_CHAOS_CORRUPT the servers
+                # corrupt their replies too, so the worker's own
+                # crc_fails proves end-to-end verification, not just
+                # server-side.
+                "chaos_corrupt": snap.get("bps_chaos_corrupt_total", 0),
+                "crc_fails": snap.get("bps_crc_fail_total", 0),
+                "crc_quarantines": snap.get(
+                    "bps_crc_quarantine_total", 0),
                 "push_partitions": snap.get("bps_push_partitions_total",
                                             0),
                 "push_bytes": snap.get("bps_push_bytes_total", 0),

@@ -60,6 +60,7 @@ KNOWN_FAMILIES = frozenset({
     "gate",
     "gpt2",
     "insight",
+    "integrity",    # ISSUE 19: wire-CRC on/off paced goodput overhead
     "mfu_attr",
     "overlap_bw",
     "priority",
