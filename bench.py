@@ -224,6 +224,14 @@ def main() -> None:
                         "interleaved paired-ratio methodology as "
                         "--trace-overhead. Writes --out "
                         "(BENCH_insight_r07.json)")
+    p.add_argument("--events-overhead", action="store_true",
+                   help="A/B the fleet event journal (BYTEPS_EVENTS_ON, "
+                        "ISSUE 20) on comm-only small-tensor fleet "
+                        "rounds: off vs on (the default, heartbeat "
+                        "piggyback + scheduler timeline + gauge history "
+                        "included). Same interleaved paired-ratio "
+                        "methodology as --insight-overhead. Writes "
+                        "--out (BENCH_events_r20.json)")
     p.add_argument("--tenants", action="store_true",
                    help="multi-tenant QoS bench (ISSUE 9): two "
                         "concurrent 2-worker jobs (weights 3:1) on one "
@@ -310,6 +318,8 @@ def main() -> None:
         return bench_trace_overhead(args)
     if args.insight_overhead:
         return bench_insight_overhead(args)
+    if args.events_overhead:
+        return bench_events_overhead(args)
     if args.elastic:
         return bench_elastic(args)
     if args.sched_recovery:
@@ -877,6 +887,91 @@ def bench_insight_overhead(args) -> None:
         },
     }
     print(json.dumps({"metric": "roundstats_overhead_pct",
+                      "value": overhead_pct, "unit": "%"}))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps({"artifact": args.out}))
+
+
+def bench_events_overhead(args) -> None:
+    """A/B the fleet event journal's cost (ISSUE 20 acceptance gate:
+    events-on — the DEFAULT — must cost <5% vs off on comm-only
+    small-tensor rounds, the BENCH_insight_r07 methodology).
+
+      off  BYTEPS_EVENTS_ON=0 — every Emit site is one relaxed atomic
+           load; no heartbeat events sub-payload (PR 19 wire bytes)
+      on   BYTEPS_EVENTS_ON=1 (the default): ring appends at lifecycle
+           sites, the new-since-last-beat piggyback on every
+           heartbeat, scheduler-side timeline ingest + 1 Hz gauge
+           history sampling
+
+    Lifecycle events are RARE by design (a steady-state round emits
+    none), so what this measures is the standing cost: the armed-check
+    at every site, the per-beat FillWire probe, and the scheduler's
+    sampling loop. Roundstats stays at its default (on) in BOTH
+    configs — this gate isolates the journal delta.
+    """
+    import os
+    import tempfile
+
+    from tools.shaped_fleet import run_fleet
+
+    repeats = args.repeats or 3
+    configs = {
+        "off": {"BYTEPS_EVENTS_ON": "0"},
+        "on": {"BYTEPS_EVENTS_ON": "1"},
+    }
+    runs = {name: [] for name in configs}
+    with tempfile.TemporaryDirectory(prefix="bps_events_bench_") as td:
+        for rep in range(repeats):
+            for name, env in configs.items():
+                rc, recs = run_fleet(
+                    args.workers, args.servers,
+                    [os.path.abspath(__file__), "--events-overhead",
+                     "--role", "trace_overhead_worker",
+                     "--rounds", str(args.rounds),
+                     "--warmup", str(args.warmup)],
+                    env_extra={**env, "BYTEPS_TRACE_DIR": td,
+                               "PS_HEARTBEAT_INTERVAL": "1"})
+                if rc != 0 or len(recs) != args.workers:
+                    raise SystemExit(
+                        f"{name} rep {rep} failed rc={rc} recs={len(recs)}")
+                agg = sum(r["steps_per_s"] for r in recs) / args.workers
+                runs[name].append({
+                    "steps_per_s": round(agg, 3),
+                    "rounds_completed": sum(r["rounds_completed"]
+                                            for r in recs),
+                })
+                print(json.dumps({"run": name, "rep": rep,
+                                  "steps_per_s": round(agg, 3)}))
+
+    def best(name):
+        return max(r["steps_per_s"] for r in runs[name])
+
+    ratios = sorted(off["steps_per_s"] / on["steps_per_s"]
+                    for off, on in zip(runs["off"], runs["on"]))
+    overhead_pct = round((statistics.median(ratios) - 1.0) * 100, 2)
+    out = {
+        "what": ("fleet event journal (BYTEPS_EVENTS_ON) standing "
+                 "overhead on comm-only ResNet-50 sub-64KB rounds, "
+                 "real 2wx2s PS fleet with 1s heartbeats (events "
+                 "piggybacking + scheduler timeline + gauge history): "
+                 "off vs on (the default); overhead = median per-rep "
+                 f"paired ratio over {repeats} interleaved reps "
+                 "(drift cancels within a rep, the BENCH_trace_r06 "
+                 "methodology)"),
+        "workers": args.workers, "servers": args.servers,
+        "rounds": args.rounds, "repeats": repeats,
+        "runs": runs,
+        "summary": {
+            "steps_per_s_events_off": best("off"),
+            "steps_per_s_events_on": best("on"),
+            "events_overhead_pct": overhead_pct,
+            "events_overhead_under_5pct": overhead_pct < 5.0,
+        },
+    }
+    print(json.dumps({"metric": "events_overhead_pct",
                       "value": overhead_pct, "unit": "%"}))
     if args.out:
         with open(args.out, "w") as f:

@@ -176,6 +176,19 @@ class SnapshotClient:
         self._ep_idx = 0
         self._req_id = 0
         self.failovers = 0  # observability: endpoint rotations so far
+        # Per-pull observability (ISSUE 20; docs/serving.md): the client
+        # mirror of the server's bps_snap_pull_us histogram, so a reader
+        # can tell "the fleet is slow" (server histogram up too) from
+        # "my path to it is flaky" (failovers/retries up, server flat).
+        self._stats = {
+            "pulls": 0,            # completed pull() batches
+            "keys": 0,             # arrays returned across all pulls
+            "restarts": 0,         # evicted-mid-batch batch restarts
+            "retries": 0,          # _pull_once attempts beyond the first
+            "not_committed_waits": 0,
+            "latency_us_sum": 0.0, "latency_us_min": float("inf"),
+            "latency_us_max": 0.0, "latency_us_last": 0.0,
+        }
 
     # -- connection management ------------------------------------------
 
@@ -310,6 +323,7 @@ class SnapshotClient:
                 return self._pull_once(key, version)
             except (OSError, ConnectionError) as e:
                 last = e
+                self._stats["retries"] += 1
                 self._rotate()
                 if attempt % len(self.endpoints) == 0 and attempt < attempts:
                     time.sleep(0.05)
@@ -319,6 +333,21 @@ class SnapshotClient:
             f"(last: {last})")
 
     # -- public API -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Lifetime per-pull stats for this client: completed pulls,
+        keys served, end-to-end batch latency (sum/mean/min/max/last,
+        microseconds — the client-side view of the server's
+        ``bps_snap_pull_us`` histogram), endpoint ``failovers``, wire
+        ``retries``, evicted-mid-batch ``restarts`` and NOT_COMMITTED
+        ``not_committed_waits``. Cheap snapshot; safe to poll."""
+        st = dict(self._stats)
+        st["failovers"] = self.failovers
+        st["latency_us_mean"] = (st["latency_us_sum"] / st["pulls"]
+                                 if st["pulls"] else 0.0)
+        if st["pulls"] == 0:
+            st["latency_us_min"] = 0.0
+        return st
 
     def pull(self, keys: Iterable[int],
              version: Union[int, str] = "latest",
@@ -336,6 +365,7 @@ class SnapshotClient:
         keylist = [int(k) for k in keys]
         want = -1 if version == "latest" else int(version)
         pinned = want
+        t0 = time.monotonic()
         for _restart in range(max_restarts + 1):
             out: Dict[int, np.ndarray] = {}
             restart = False
@@ -388,6 +418,7 @@ class SnapshotClient:
                         # a fleet that never commits cannot hang us.
                         unknown.clear()  # the disclaim sweep is void
                         waits += 1
+                        self._stats["not_committed_waits"] += 1
                         if waits * not_committed_wait > self.timeout * 4:
                             raise SnapshotError(
                                 f"key {key}: no committed snapshot "
@@ -404,7 +435,16 @@ class SnapshotClient:
                 if restart:
                     break
             if not restart:
+                st = self._stats
+                us = (time.monotonic() - t0) * 1e6
+                st["pulls"] += 1
+                st["keys"] += len(out)
+                st["latency_us_sum"] += us
+                st["latency_us_min"] = min(st["latency_us_min"], us)
+                st["latency_us_max"] = max(st["latency_us_max"], us)
+                st["latency_us_last"] = us
                 return pinned, out
+            self._stats["restarts"] += 1
             pinned = -1
         raise SnapshotError(
             f"could not complete a consistent cut of {len(keylist)} "

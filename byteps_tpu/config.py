@@ -131,6 +131,25 @@ class Config:
     #   `python -m byteps_tpu.monitor.insight --watch` reads. 0 keeps
     #   round summaries rank-local
 
+    # --- fleet event journal (ISSUE 20; docs/monitoring.md) ----------------
+    events_on: bool = True                # BYTEPS_EVENTS_ON
+    #   always-on structured lifecycle journal on every role (joins,
+    #   leaves, deaths, pause/resume epochs, scheduler fail-over,
+    #   checkpoint spills/seals/restores, snapshot commits, CRC
+    #   quarantines, ...). Non-scheduler ranks piggyback new events on
+    #   CMD_HEARTBEAT; the scheduler ingests them into the clock-aligned
+    #   fleet timeline served at /events and read by monitor.incident.
+    #   Default ON — overhead is within noise (BENCH_events_r20.json);
+    #   0 reduces every emit site to one relaxed atomic load
+    events_ring: int = 512                # BYTEPS_EVENTS_RING
+    #   per-rank journal ring capacity (drop-oldest; overwrites are
+    #   reported as `dropped` in bps_events_summary and flagged by
+    #   monitor.incident). The scheduler timeline holds 4x this
+    events_history: int = 128             # BYTEPS_EVENTS_HISTORY
+    #   scheduler-side per-gauge history ring length (1 Hz samples of
+    #   every registered gauge, served in /events as `history` and
+    #   summarised by monitor.incident)
+
     # --- live monitoring (byteps_tpu.monitor, docs/monitoring.md) ----------
     monitor_on: bool = False              # BYTEPS_MONITOR_ON
     monitor_port: int = 9100              # BYTEPS_MONITOR_PORT (BASE port:
@@ -482,6 +501,16 @@ class Config:
                 "record ring capacity, drop-oldest; set "
                 "BYTEPS_ROUNDSTATS_ON=0 to disable round summaries "
                 "instead of shrinking the ring to nothing)")
+        if self.events_ring < 16:
+            raise ValueError(
+                "BYTEPS_EVENTS_RING must be >= 16 (per-rank journal "
+                "ring capacity, drop-oldest; set BYTEPS_EVENTS_ON=0 to "
+                "disable the journal instead of shrinking the ring to "
+                "nothing)")
+        if self.events_history < 8:
+            raise ValueError(
+                "BYTEPS_EVENTS_HISTORY must be >= 8 (scheduler "
+                "per-gauge history ring length)")
         if self.num_worker < 1:
             raise ValueError("DMLC_NUM_WORKER must be >= 1")
         if self.ps_mode not in ("auto", "collective", "ps"):
@@ -897,6 +926,9 @@ def load_config() -> Config:
         roundstats_ring=_env_int("BYTEPS_ROUNDSTATS_RING", 256),
         roundstats_heartbeat_summary=_env_bool(
             "BYTEPS_ROUNDSTATS_HEARTBEAT_SUMMARY", True),
+        events_on=_env_bool("BYTEPS_EVENTS_ON", True),
+        events_ring=_env_int("BYTEPS_EVENTS_RING", 512),
+        events_history=_env_int("BYTEPS_EVENTS_HISTORY", 128),
         monitor_on=_env_bool("BYTEPS_MONITOR_ON"),
         monitor_port=_env_int("BYTEPS_MONITOR_PORT", 9100),
         straggler_factor=float(

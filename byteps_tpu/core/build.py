@@ -22,6 +22,7 @@ SOURCES = [
     "trace.cc",
     "tenancy.cc",
     "roundstats.cc",
+    "events.cc",
     "van.cc",
     "postoffice.cc",
     "cpu_reducer.cc",

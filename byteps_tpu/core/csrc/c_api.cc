@@ -23,6 +23,7 @@
 #include "cpu_reducer.h"
 #include "debug.h"
 #include "elastic.h"
+#include "events.h"
 #include "kv.h"
 #include "logging.h"
 #include "metrics.h"
@@ -243,6 +244,15 @@ int bps_init(int role) {
                       DefaultCompConfig(), EnvBool("BYTEPS_TRACE_ON"));
   }
 
+  // Event-journal identity must exist BEFORE the postoffice starts: on
+  // a crash-restarted scheduler the whole re-register -> recovery-
+  // commit window runs INSIDE Start(), and only role-0 emits enter the
+  // fleet timeline directly. The scheduler's id is fixed (0); other
+  // roles learn theirs when Start returns — their pre-topology records
+  // carry node -1 and the scheduler backfills identity from the wire
+  // chunk's header at ingest.
+  Events::Get().SetNode(role, gl->role == ROLE_SCHEDULER ? 0 : -1);
+
   int id = gl->po->Start(gl->role, uri, port, nw, ns, std::move(handler));
   // Elastic joiner (DMLC_JOIN): the scheduler's direct ADDRBOOK carried
   // the round boundary this rank enters at — every tensor declared from
@@ -275,11 +285,29 @@ int bps_init(int role) {
   // Round-summary identity (ISSUE 7): stamps the heartbeat piggyback
   // so the scheduler's fleet table keys on real node ids.
   RoundStats::Get().SetNode(role, id);
+  // Event-journal identity (ISSUE 20): same contract — wire chunks and
+  // journal records carry the real node id from the first emit on.
+  Events::Get().SetNode(role, id);
   Metrics::Get().Counter("bps_trace_events_total");
   Metrics::Get().Counter("bps_trace_dropped_total");
   Metrics::Get().Counter("bps_flight_dumps_total");
+  Metrics::Get().Counter("bps_events_emitted_total");
   if (gl->role == ROLE_SCHEDULER) {
     Metrics::Get().Counter("bps_round_summaries_ingested_total");
+    Metrics::Get().Counter("bps_events_ingested_total");
+  }
+  // Wire-CRC series pre-registration (ISSUE 20 satellite): where the
+  // data-plane CRC is armed, its health counters must serve from zero
+  // on every /metrics page — absent-until-first-corruption reads as
+  // "CRC off" to dashboards, which is exactly backwards. Unarmed
+  // builds keep the page byte-for-byte (same contract as the server
+  // ctor's BYTEPS_CKPT_DIR-gated ckpt series).
+  if (const char* crc = getenv("BYTEPS_WIRE_CRC");
+      crc && *crc && *crc != '0') {
+    Metrics::Get().Counter("bps_crc_fail_total");
+    Metrics::Get().Counter("bps_crc_quarantine_total");
+    Metrics::Get().Counter("bps_crc_quarantine_links_total");
+    Metrics::Get().Gauge("bps_link_corrupting");
   }
   // Replica delta subscription starts only now: the poll loop dials the
   // primary out of the address book, which exists only after Start.
@@ -1473,6 +1501,54 @@ int bps_metrics_observe(const char* kind, const char* name, long long v) {
     return 0;
   }
   return -1;
+}
+
+// --- fleet event journal (ISSUE 20) -----------------------------------------
+
+// Whole-journal JSON: local ring + (scheduler) fleet timeline + metric
+// history rings. Same buffer contract as bps_metrics_snapshot: returns
+// the byte length needed; copies + NUL-terminates only when it fits.
+long long bps_events_summary(char* buf, long long maxlen) {
+  std::string out = Events::Get().SnapshotJson();
+  long long need = static_cast<long long>(out.size());
+  if (buf && maxlen > need) {
+    memcpy(buf, out.data(), static_cast<size_t>(need));
+    buf[need] = '\0';
+  }
+  return need;
+}
+
+// Emit one event through the production path (ring, counters, and — on
+// a scheduler — the fleet timeline). The FFI hook behind the Python
+// monitor layer's journal writes (insight classifications, POST
+// /events) and the reachability tests. Returns 0, or -1 on a type
+// outside the catalog.
+int bps_events_emit(int type, long long a0, long long a1, long long a2) {
+  if (type <= EV_NONE || type >= EV_TYPE_COUNT) return -1;
+  Events::Get().Emit(static_cast<EventType>(type), a0, a1, a2);
+  return 0;
+}
+
+// Fill a heartbeat events sub-payload exactly as HeartbeatLoop would
+// (new-since-last-beat, capped at kMaxWireEvents). Returns the bytes
+// written, 0 when there is nothing new (or the journal is off), or
+// the negated length needed when `maxlen` is too small — the chunk
+// must ship whole or not at all (wire chunks are not resumable).
+long long bps_events_fill_wire(char* buf, long long maxlen) {
+  std::string out;
+  if (!Events::Get().FillWire(&out)) return 0;
+  long long need = static_cast<long long>(out.size());
+  if (!buf || maxlen < need) return -need;
+  memcpy(buf, out.data(), static_cast<size_t>(need));
+  return need;
+}
+
+// Ingest one events wire chunk as the scheduler's heartbeat handler
+// would. Returns 1 when ingested, 0 when rejected (foreign magic,
+// version skew, short frame) — the interop contract the tests pin.
+int bps_events_ingest(const void* data, long long len) {
+  if (!data || len <= 0) return 0;
+  return Events::Get().Ingest(data, static_cast<size_t>(len)) ? 1 : 0;
 }
 
 }  // extern "C"

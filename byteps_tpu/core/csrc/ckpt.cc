@@ -11,6 +11,7 @@
 #include <cstdio>
 #include <cstring>
 
+#include "events.h"
 #include "logging.h"
 #include "metrics.h"
 #include "worker.h"  // NowUs
@@ -557,6 +558,8 @@ void CkptWriter::Loop() {
       queue_.pop_front();
     }
     const int64_t t0 = NowUs();
+    Events::Get().Emit(EV_CKPT_SPILL, job.first,
+                       static_cast<int64_t>(job.second.size()));
     std::string why;
     if (CkptSpillSync(dir_, rank_, job.first, job.second, num_workers_,
                       num_servers_, chaos_, &why)) {
@@ -567,10 +570,12 @@ void CkptWriter::Loop() {
       BPS_METRIC_GAUGE_SET("bps_ckpt_version", job.first);
       BPS_METRIC_COUNTER_ADD("bps_ckpt_spills_total", 1);
       BPS_METRIC_GAUGE_SET("bps_ckpt_spill_ms", ms);
+      Events::Get().Emit(EV_CKPT_SEAL, job.first, ms, /*ok=*/1);
       CkptRetain(dir_, rank_, retain_);
     } else {
       failures_.fetch_add(1);
       BPS_METRIC_COUNTER_ADD("bps_ckpt_failures_total", 1);
+      Events::Get().Emit(EV_CKPT_SEAL, job.first, 0, /*ok=*/0);
       BPS_LOG(WARNING) << "ckpt: spill of version " << job.first
                      << " FAILED: " << why;
     }

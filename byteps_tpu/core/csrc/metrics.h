@@ -111,6 +111,17 @@ class Metrics {
     return out;
   }
 
+  // Enumerate the registered gauges under the registry lock (ISSUE 20:
+  // the scheduler samples every gauge into its event-journal history
+  // rings). Registration-ordered; fn(name, current_value).
+  template <typename Fn>
+  void ForEachGauge(Fn fn) {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (const auto& kv : gauges_) {
+      fn(kv.first, kv.second->load(std::memory_order_relaxed));
+    }
+  }
+
  private:
   using ScalarMap =
       std::map<std::string, std::unique_ptr<std::atomic<int64_t>>>;

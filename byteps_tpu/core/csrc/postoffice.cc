@@ -6,6 +6,7 @@
 #include <chrono>
 #include <cstring>
 
+#include "events.h"
 #include "logging.h"
 #include "metrics.h"
 #include "roundstats.h"
@@ -148,6 +149,7 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
       }
       if (corrupt_failed) {
         Trace::Get().Note("PEER_LOST", 0, node_id);
+        Events::Get().Emit(EV_DEATH, node_id, /*replica=*/0);
         if (peer_lost_cb_) peer_lost_cb_(node_id);
         return;
       }
@@ -222,6 +224,7 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
       return;
     }
     Trace::Get().Note("PEER_LOST", 0, node_id);
+    Events::Get().Emit(EV_DEATH, node_id, /*replica=*/0);
     if (peer_lost_cb_) peer_lost_cb_(node_id);
   });
   // Flaky-link quarantine attribution (ISSUE 19): the van tripped the
@@ -269,12 +272,14 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
                      << "x, past the reconnect budget (" << budget
                      << "); failing the peer (fail-stop)";
       Trace::Get().Note("LINK_CORRUPTING", count, node_id);
+      Events::Get().Emit(EV_CRC_FAILSTOP, node_id, count);
       Trace::Get().FlightDumpAuto("corrupting_link");
     } else {
       BPS_LOG(WARNING) << "node " << my_id_ << ": CRC quarantine #"
                        << count << " on link " << link
                        << " — forcing a re-dial through a fresh socket";
       Trace::Get().Note("LINK_QUARANTINED", count, node_id);
+      Events::Get().Emit(EV_CRC_QUARANTINE, node_id, count);
     }
   });
 
@@ -587,6 +592,7 @@ int Postoffice::Start(Role role, const std::string& root_uri, int root_port,
             BPS_METRIC_GAUGE_SET("bps_fleet_replicas", replica_count_);
             BPS_METRIC_COUNTER_ADD("bps_replica_deaths_total", 1);
             Trace::Get().Note("REPLICA_LOST", 0, rid);
+            Events::Get().Emit(EV_DEATH, rid, /*replica=*/1);
             it = dead.erase(it);
           }
         }
@@ -763,6 +769,7 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
                      "BYTEPS_CKPT_RESTORE to start fresh)";
               restore = minv;
               restore_round_.store(restore);
+              Events::Get().Emit(EV_CKPT_RESTORE, restore, nsrv);
               BPS_LOG(WARNING)
                   << "scheduler: restore epoch committed at checkpoint "
                      "version " << restore
@@ -890,13 +897,32 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
           last_heartbeat_ms_[msg.head.sender] = NowMs();
         }
       }
-      // Piggybacked round summaries (ISSUE 7): a versioned sub-payload
-      // of the rounds the sender completed since its last beat. Ingest
-      // validates magic/version/length and silently ignores anything
-      // else, so old senders (empty payload) and future generations
-      // interop; the heartbeat itself needed only the header above.
+      // Piggybacked telemetry: the heartbeat payload multiplexes
+      // versioned, magic-tagged sub-payloads — round summaries (ISSUE
+      // 7, 0xB57A) and journal events (ISSUE 20, 0xE7B5), in either
+      // order. Walk them chunk by chunk; unknown leading bytes end the
+      // walk (old senders and future generations interop — each
+      // ingester validates magic/version/length itself and the
+      // heartbeat only needed the header above).
       if (role_ == ROLE_SCHEDULER && !msg.payload.empty()) {
-        RoundStats::Get().Ingest(msg.payload.data(), msg.payload.size());
+        const char* p = msg.payload.data();
+        size_t left = msg.payload.size();
+        while (left > 0) {
+          size_t used = RoundStats::WireSize(p, left);
+          if (used) {
+            RoundStats::Get().Ingest(p, left);
+          } else if ((used = Events::PeekWireSize(p, left)) != 0) {
+            Events::Get().Ingest(p, left);
+          } else {
+            break;
+          }
+          p += used;
+          left -= used;
+        }
+        // Heartbeats are also the scheduler's history clock: sample
+        // the gauge registry into the journal's per-metric rings
+        // (rate-limited inside to one sample per second).
+        Events::Get().SampleHistory(NowUs());
       }
       // Echo for clock alignment (ISSUE 5): arg0 = the sender's send
       // timestamp, arg1 = this (scheduler) clock now. The sender keeps
@@ -927,6 +953,7 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
           clock_rtt_us_.store(rtt);
           clock_offset_us_.store(offset);
           Trace::Get().SetClock(offset, rtt);
+          Events::Get().SetClock(offset);
         }
       }
       break;
@@ -958,6 +985,7 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
       // exactly when the last N events are worth keeping — dump now so
       // even a rank that dies mid-recovery leaves a record.
       Trace::Get().Note("EPOCH_PAUSE", msg.head.arg0, node);
+      Events::Get().Emit(EV_EPOCH_PAUSE, msg.head.arg0, node);
       Trace::Get().FlightDumpAuto("epoch_pause");
       if (role_ == ROLE_WORKER && peer_paused_cb_) peer_paused_cb_(node);
       break;
@@ -997,6 +1025,7 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
                        << " replaced at " << info.host << ":"
                        << info.port;
       Trace::Get().Note("EPOCH_RESUME", msg.head.arg0, node);
+      Events::Get().Emit(EV_EPOCH_RESUME, msg.head.arg0, node);
       Trace::Get().FlightDumpAuto("epoch_resume");
       if (role_ == ROLE_WORKER) {
         if (dialed && peer_recovered_cb_) {
@@ -1064,6 +1093,8 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
       BPS_METRIC_GAUGE_SET("bps_membership_epoch", epoch_.load());
       Trace::Get().Note("FLEET_PAUSE", msg.head.arg0,
                         static_cast<int>(msg.head.key), -1, kind);
+      Events::Get().Emit(EV_FLEET_PAUSE, msg.head.arg0,
+                         static_cast<int64_t>(msg.head.key), kind);
       Trace::Get().FlightDumpAuto("fleet_pause");
       BPS_LOG(WARNING) << "node " << my_id_ << ": epoch "
                        << msg.head.arg0 << " FLEET_PAUSE — worker "
@@ -1128,6 +1159,7 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
                        << affected << ")";
       Trace::Get().Note("FLEET_RESUME", msg.head.arg0, affected, -1,
                         kind);
+      Events::Get().Emit(EV_FLEET_RESUME, msg.head.arg0, affected, kind);
       Trace::Get().FlightDumpAuto("fleet_resume");
       if (role_ == ROLE_SERVER && fleet_resize_cb_) {
         fleet_resize_cb_(kind, affected, jr, jb, msg.head.tenant);
@@ -1181,6 +1213,7 @@ void Postoffice::ControlHandler(Message&& msg, int fd) {
         if (msg.head.arg0 == 1) {
           failure_shutdown_.store(true);
           Trace::Get().Note("FAILURE_SHUTDOWN", 0, msg.head.sender);
+          Events::Get().Emit(EV_SHUTDOWN, /*failure=*/1, msg.head.sender);
           Trace::Get().FlightDumpAuto("failure_shutdown");
         }
         shutting_down_.store(true);
@@ -1305,6 +1338,7 @@ void Postoffice::BroadcastFailureLocked(const std::string& why) {
   BPS_LOG(WARNING) << "scheduler: " << why
                    << " — broadcasting failure shutdown";
   Trace::Get().Note("FAILURE_SHUTDOWN");
+  Events::Get().Emit(EV_SHUTDOWN, /*failure=*/1, my_id_);
   Trace::Get().FlightDumpAuto("failure_shutdown");
   MsgHeader h{};
   h.cmd = CMD_SHUTDOWN;
@@ -1321,6 +1355,7 @@ void Postoffice::BroadcastFailureLocked(const std::string& why) {
 
 void Postoffice::StartRecoveryLocked(int node_id) {
   Trace::Get().Note("EPOCH_PAUSE", epoch_.load() + 1, node_id);
+  Events::Get().Emit(EV_EPOCH_PAUSE, epoch_.load() + 1, node_id);
   Trace::Get().FlightDumpAuto("epoch_pause");
   epoch_.fetch_add(1);
   recovering_node_ = node_id;
@@ -1383,6 +1418,7 @@ void Postoffice::HandleRecoverRegister(int fd, const NodeInfo& info,
     StartRecoveryLocked(id);
   }
   Trace::Get().Note("RECOVER_REGISTER", rank, id);
+  Events::Get().Emit(EV_SERVER_RECOVER, id, rank);
   NodeInfo adopted = info;
   adopted.id = id;
   adopted.role = ROLE_SERVER;
@@ -1422,6 +1458,7 @@ void Postoffice::HandleRecoverRegister(int fd, const NodeInfo& info,
                    << adopted.host << ":" << adopted.port << " (epoch "
                    << epoch_.load() << ")";
   Trace::Get().Note("EPOCH_RESUME", epoch_.load(), id);
+  Events::Get().Emit(EV_EPOCH_RESUME, epoch_.load(), id);
   Trace::Get().FlightDumpAuto("epoch_resume");
 }
 
@@ -1453,6 +1490,7 @@ void Postoffice::AdmitReplicaLocked(int fd, const NodeInfo& info_in,
   BPS_METRIC_GAUGE_SET("bps_fleet_replicas", replica_count_);
   Trace::Get().Instant("register", id, id, -1, ROLE_REPLICA);
   Trace::Get().Note("REPLICA_ADMIT", primary_rank, id);
+  Events::Get().Emit(EV_JOIN, id, /*replica=*/1, primary_rank);
   // Direct book, recovery-registration style: formation (if any)
   // already happened and must not be re-opened for a read-only node.
   MsgHeader ab{};
@@ -1493,6 +1531,7 @@ bool Postoffice::ParkOnSchedulerLost() {
                    << " ms); data plane keeps draining against the "
                       "last committed address book";
   Trace::Get().Note("SCHED_LOST_PARK", window);
+  Events::Get().Emit(EV_SCHED_PARK, window);
   // Park dump: the pre-crash control-plane trail is exactly what a
   // post-mortem needs if the recovery then fails too.
   Trace::Get().FlightDumpAuto("scheduler_lost");
@@ -1654,6 +1693,7 @@ void Postoffice::HandleReregister(Message&& msg, int fd) {
                    << " re-registered (epoch " << msg.head.arg0 << ") — "
                    << rereg << "/" << expected << " toward quorum";
   Trace::Get().Note("SCHED_REREGISTER", msg.head.arg0, id);
+  Events::Get().Emit(EV_SCHED_REREGISTER, id, msg.head.arg0);
   if (sched_rec_.Conflict()) {
     // Same-epoch books disagree: the old scheduler died mid-commit
     // and there is no single committed state to resume from.
@@ -1709,6 +1749,8 @@ void Postoffice::CommitSchedRecoveryLocked() {
                    << sched_rec_.RoundsWatermark();
   Trace::Get().Note("SCHED_RECOVERY_COMMIT", epoch_.load(),
                     sched_rec_.Reregistered());
+  Events::Get().Emit(EV_SCHED_RECOVERY_COMMIT, epoch_.load(),
+                     sched_rec_.Reregistered());
   Trace::Get().FlightDumpAuto("sched_recovery_commit");
   // Broadcast exactly like an elastic commit: a re-issued ADDRBOOK
   // (arg0 = the receiver's own id) followed by the RESUME, in order,
@@ -1918,6 +1960,8 @@ void Postoffice::StartMemberOpLocked(MemberOp&& op) {
   BPS_METRIC_GAUGE_SET("bps_fleet_resizing", 1);
   Trace::Get().Note("FLEET_PAUSE", epoch_.load(), member_op_.node_id,
                     -1, member_op_.kind);
+  Events::Get().Emit(EV_FLEET_PAUSE, epoch_.load(), member_op_.node_id,
+                     member_op_.kind);
   Trace::Get().FlightDumpAuto("fleet_pause");
   BPS_LOG(WARNING) << "scheduler: epoch " << epoch_.load()
                    << " worker membership change — "
@@ -2012,6 +2056,11 @@ void Postoffice::CompleteMemberOpLocked() {
                        NowMs() - member_start_ms_);
   Trace::Get().Note("FLEET_RESUME", epoch_.load(), op.node_id, -1,
                     op.kind);
+  Events::Get().Emit(EV_FLEET_RESUME, epoch_.load(), op.node_id, op.kind);
+  // The commit IS the join/leave moment fleet-wide — journal it as the
+  // membership event post-mortems sort by, not the pause that opened it.
+  Events::Get().Emit(op.kind == 0 ? EV_JOIN : EV_LEAVE, op.node_id,
+                     /*replica=*/0);
   Trace::Get().FlightDumpAuto("fleet_resume");
   {
     // Live tenant-count gauge (a tenant appears with its first worker
@@ -2154,6 +2203,12 @@ void Postoffice::HeartbeatLoop() {
     // fault harness provably leaves alone (the PR 3 contract).
     std::string rs_payload;
     RoundStats::Get().FillWire(&rs_payload);
+    // Journal events ride as a SECOND magic-tagged sub-payload (ISSUE
+    // 20) behind the round summaries: RoundStats::Ingest tolerates
+    // trailing bytes, so old schedulers simply never see the chunk,
+    // and with events off nothing is appended — the payload stays
+    // byte-for-byte the PR 19 wire.
+    Events::Get().FillWire(&rs_payload);
     if (!van_->Send(fd, h, rs_payload.data(),
                     static_cast<int64_t>(rs_payload.size()))) {
       // Scheduler fail-over (ISSUE 15): with it armed, park instead of
@@ -2175,6 +2230,7 @@ void Postoffice::HeartbeatLoop() {
         BPS_LOG(WARNING) << "node " << my_id_
                          << ": scheduler connection lost — failure shutdown";
         Trace::Get().Note("SCHED_CONN_LOST");
+        Events::Get().Emit(EV_SHUTDOWN, /*failure=*/1, kSchedulerId);
         Trace::Get().FlightDumpAuto("scheduler_lost");
         failure_shutdown_.store(true);
         shutting_down_.store(true);

@@ -207,6 +207,20 @@ bool RoundStats::FillWire(std::string* out) {
   return true;
 }
 
+size_t RoundStats::WireSize(const void* data, size_t len) {
+  if (!data || len < sizeof(RoundSummaryHdr)) return 0;
+  RoundSummaryHdr hdr;
+  memcpy(&hdr, data, sizeof(hdr));
+  if (hdr.magic != kRoundSummaryMagic ||
+      hdr.version != kRoundSummaryVersion) {
+    return 0;
+  }
+  if (hdr.count < 0 || hdr.count > kMaxWireRecs) return 0;
+  size_t need =
+      sizeof(hdr) + static_cast<size_t>(hdr.count) * sizeof(RoundRec);
+  return len >= need ? need : 0;
+}
+
 bool RoundStats::Ingest(const void* data, size_t len) {
   if (len < sizeof(RoundSummaryHdr)) return false;
   RoundSummaryHdr hdr;

@@ -129,8 +129,16 @@ class RoundStats {
 
   // Scheduler side: ingest one heartbeat sub-payload. Returns false —
   // and changes nothing — when the payload is not a recognized
-  // summary (old sender, foreign magic, short frame).
+  // summary (old sender, foreign magic, short frame). Trailing bytes
+  // past the advertised count are tolerated — that slack is what lets
+  // the events journal (ISSUE 20) append a second sub-payload behind
+  // this one without breaking older receivers.
   bool Ingest(const void* data, size_t len);
+
+  // Bytes a recognized round-summary sub-payload at `data` occupies
+  // (0 when not ours) — the heartbeat payload multiplexes magic-tagged
+  // chunks (ISSUE 20) and the scheduler walks them with this.
+  static size_t WireSize(const void* data, size_t len);
 
   // Most recent finalized round (false when none yet).
   bool LastCompleted(RoundRec* out);

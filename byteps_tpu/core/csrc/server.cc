@@ -7,6 +7,7 @@
 #include <cstring>
 
 #include "cpu_reducer.h"
+#include "events.h"
 #include "logging.h"
 #include "metrics.h"
 #include "roundstats.h"
@@ -149,6 +150,7 @@ void BytePSServer::Start(Postoffice* po, int engine_threads, bool async_mode,
   // publication/read/eviction counters, and the replica's lag behind
   // its primary's committed version (always 0 on a primary).
   Metrics::Get().Counter("bps_snap_pulls_total");
+  Metrics::Get().Histogram("bps_snap_pull_us");
   Metrics::Get().Counter("bps_snap_publish_total");
   Metrics::Get().Counter("bps_snap_evictions_total");
   Metrics::Get().Gauge("bps_snapshot_version");
@@ -475,7 +477,25 @@ void BytePSServer::EngineLoop(int tid) {
     TenantStat* ts = Tenancy::Get().Of(tenant);
     ts->queue_depth.fetch_sub(1, std::memory_order_relaxed);
     ts->dispatched.fetch_add(cost, std::memory_order_relaxed);
-    ts->last_serve_us.store(NowUs(), std::memory_order_relaxed);
+    // Starvation episode close (ISSUE 20): this serve ends any gap the
+    // tenant spent flagged STARVED (/tenants semantics: queued work,
+    // no dispatch for > BYTEPS_TENANT_STARVE_MS). Journal the episode
+    // exactly once — at its close, with the measured gap — instead of
+    // polling the flag.
+    {
+      static const int64_t starve_us = [] {
+        const char* v = getenv("BYTEPS_TENANT_STARVE_MS");
+        long long ms = v && *v ? atoll(v) : 2000;
+        return ms > 0 ? ms * 1000 : 2000 * 1000;
+      }();
+      const int64_t now = NowUs();
+      const int64_t last =
+          ts->last_serve_us.load(std::memory_order_relaxed);
+      if (last > 0 && now - last > starve_us) {
+        Events::Get().Emit(EV_TENANT_STARVED, tenant, now - last);
+      }
+      ts->last_serve_us.store(now, std::memory_order_relaxed);
+    }
     if (task.msg.head.cmd == kCmdShrink) {
       ShrinkWorker(tid, static_cast<int>(task.msg.head.arg0), tenant);
       continue;
@@ -698,7 +718,10 @@ void BytePSServer::ShrinkWorker(int tid, int dead, uint16_t tenant) {
                      << " partial contribution(s), completed "
                      << completed << " round(s) on the survivors";
   }
-  if (dead >= 0) Trace::Get().Note("WORKER_SHRINK", rolled, dead, -1, completed);
+  if (dead >= 0) {
+    Trace::Get().Note("WORKER_SHRINK", rolled, dead, -1, completed);
+    Events::Get().Emit(EV_LEAVE, dead, /*replica=*/0, rolled);
+  }
 }
 
 BytePSServer::KeyStore* BytePSServer::GetStore(uint16_t tenant,
@@ -1207,6 +1230,7 @@ void BytePSServer::Process(EngineTask&& task) {
       KeyStore* ks = GetStore(h.tenant, h.key);
       BPS_CHECK(ks) << "reseed for undeclared key " << h.key;
       Trace::Get().Note("RESEED", h.key, h.sender, h.req_id, h.version);
+      Events::Get().Emit(EV_RESEED, h.key, h.sender, h.version);
       InstallAggregate(ks, h.version, msg.payload.data(),
                        msg.payload.size(), "reseed");
       MsgHeader ack{};
@@ -1309,6 +1333,10 @@ void BytePSServer::Process(EngineTask&& task) {
 }
 
 void BytePSServer::ProcessSnapPull(EngineTask& task) {
+  // Serve-side read latency (ISSUE 20 satellite): resolve + reply
+  // enqueue, misses included — the replica-vs-primary serve cost the
+  // client-side SnapshotClient.stats() latency cannot decompose.
+  const int64_t serve_t0 = NowUs();
   const MsgHeader& h = task.msg.head;
   SnapEntry ent;
   int64_t resolved = -1;
@@ -1331,6 +1359,7 @@ void BytePSServer::ProcessSnapPull(EngineTask& task) {
   BPS_METRIC_COUNTER_ADD("bps_snap_pulls_total", 1);
   if (code != SnapStore::OK) {
     po_->van().Send(task.fd, resp);
+    BPS_METRIC_HISTO_OBSERVE("bps_snap_pull_us", NowUs() - serve_t0);
     return;
   }
   resp.dtype = ent.dtype;
@@ -1358,6 +1387,7 @@ void BytePSServer::ProcessSnapPull(EngineTask& task) {
                          static_cast<int64_t>(body->size()));
   po_->van().Send(task.fd, resp, body->data(),
                   static_cast<int64_t>(body->size()));
+  BPS_METRIC_HISTO_OBSERVE("bps_snap_pull_us", NowUs() - serve_t0);
 }
 
 void BytePSServer::ProcessSnapSub(EngineTask& task) {
@@ -1458,6 +1488,21 @@ void BytePSServer::ProcessSnapDelta(EngineTask& task) {
   snaps_.ForceLatest(h.version);
   const int64_t lag = h.arg1 >= 0 ? h.arg1 - snaps_.latest() : 0;
   BPS_METRIC_GAUGE_SET("bps_replica_lag_rounds", lag > 0 ? lag : 0);
+  // Lag-warn journal entry (ISSUE 20): emitted on the CROSSING into
+  // lagging (monitor.top's REPLICA-LAGGING threshold), not per batch —
+  // a replica stuck behind would otherwise flood the ring.
+  {
+    static const int64_t lag_warn = [] {
+      const char* v = getenv("BYTEPS_REPLICA_LAG_ROUNDS");
+      long long r = v && *v ? atoll(v) : 8;
+      return r > 0 ? r : 8;
+    }();
+    const bool lagging = lag > lag_warn;
+    if (lagging && !replica_lagging_) {
+      Events::Get().Emit(EV_REPLICA_LAG, lag, snaps_.latest());
+    }
+    replica_lagging_ = lagging;
+  }
   BPS_METRIC_GAUGE_SET("bps_snapshot_version", snaps_.latest());
   if (count > 0) {
     Trace::Get().Note("SNAP_DELTA", count, static_cast<int>(h.version));

@@ -461,6 +461,9 @@ class BytePSServer {
   // (BYTEPS_REPLICA_OF); -1 = a normal training-plane server.
   int replica_of_ = -1;
   std::thread replica_thread_;
+  // Replica poll thread only: edge-triggers the EV_REPLICA_LAG journal
+  // entry on the crossing into REPLICA-LAGGING (ISSUE 20).
+  bool replica_lagging_ = false;
 
   // --- durable checkpoints (ISSUE 18) ---
   // BYTEPS_CKPT_DIR: spill root; empty = checkpointing off entirely

@@ -32,6 +32,8 @@
 #include <utility>
 #include <vector>
 
+#include "events.h"
+
 namespace bps {
 
 // Stale-reply guard for the server's per-slot cached re-encodes
@@ -125,6 +127,7 @@ class SnapStore {
     // has published v — the cut is complete by construction. A key set
     // that grows mid-round can stall one version's count; the next
     // full round supersedes it (latest is a running max).
+    const int64_t pre_commit = latest_;
     size_t n = ++pub_count_[version];
     if (n >= keys_.size() && version > latest_) latest_ = version;
     // Lockstep commit: the sync engine publishes a key's round v only
@@ -139,6 +142,12 @@ class SnapStore {
     for (auto it = pub_count_.begin(); it != pub_count_.end();) {
       it = (it->first <= latest_) ? pub_count_.erase(it) : ++it;
     }
+    if (latest_ > pre_commit) {
+      // Journal the version-commit edge, not the per-key publishes: one
+      // EV_SNAP_COMMIT per serving-visible version advance (ISSUE 20).
+      Events::Get().Emit(EV_SNAP_COMMIT, latest_,
+                         static_cast<int64_t>(keys_.size()));
+    }
     return true;
   }
 
@@ -146,7 +155,12 @@ class SnapStore {
   // delta batch carries everything up to it). Monotone.
   void ForceLatest(int64_t version) {
     std::lock_guard<std::mutex> lk(mu_);
-    if (version > latest_) latest_ = version;
+    if (version > latest_) {
+      latest_ = version;
+      Events::Get().Emit(EV_SNAP_COMMIT, latest_,
+                         static_cast<int64_t>(keys_.size()),
+                         /*adopted=*/1);
+    }
   }
 
   int64_t latest() const {
@@ -277,8 +291,16 @@ class SnapStore {
  private:
   void Trim(std::deque<SnapEntry>* ring) {
     while (ring->size() > static_cast<size_t>(retain_)) {
+      const int64_t ev = ring->front().version;
       ring->pop_front();
       evictions_++;
+      // One journal entry per version falling out of the retain window
+      // — NOT per (key, version): with K keys a round boundary evicts K
+      // entries of the same version and would flood the event ring.
+      if (ev > evict_emit_ver_) {
+        evict_emit_ver_ = ev;
+        Events::Get().Emit(EV_SNAP_EVICT, ev, evictions_);
+      }
     }
   }
 
@@ -288,6 +310,7 @@ class SnapStore {
   int64_t latest_ = -1;  // highest committed (complete-cut) version
   int64_t publishes_ = 0;
   int64_t evictions_ = 0;
+  int64_t evict_emit_ver_ = -1;  // highest version already journaled
   std::map<std::pair<uint16_t, int64_t>, std::deque<SnapEntry>> keys_;
   std::map<int64_t, size_t> pub_count_;  // uncommitted versions only
 };

@@ -32,6 +32,7 @@
 #endif
 
 #include "crc32c.h"
+#include "events.h"
 #include "logging.h"
 #include "metrics.h"
 #include "shm_ring.h"
@@ -550,6 +551,7 @@ bool Van::SendV(int fd, const MsgHeader& head, const struct iovec* segs,
       BPS_METRIC_COUNTER_ADD("bps_chaos_injected_total", 1);
       BPS_METRIC_COUNTER_ADD("bps_chaos_reset_total", 1);
       Trace::Get().Note("CHAOS_RESET", h.key, -1, h.req_id);
+      Events::Get().Emit(EV_CHAOS, /*kind=*/0, h.key);
       if (VerboseLevel() >= 2) {
         fprintf(stderr, "[PS_VERBOSE] van CHAOS reset fd=%d\n", fd);
       }
@@ -568,6 +570,7 @@ bool Van::SendV(int fd, const MsgHeader& head, const struct iovec* segs,
       BPS_METRIC_COUNTER_ADD("bps_chaos_injected_total", 1);
       BPS_METRIC_COUNTER_ADD("bps_chaos_drop_total", 1);
       Trace::Get().Note("CHAOS_DROP", h.key, -1, h.req_id);
+      Events::Get().Emit(EV_CHAOS, /*kind=*/1, h.key);
       if (VerboseLevel() >= 2) {
         fprintf(stderr, "[PS_VERBOSE] van CHAOS drop fd=%d cmd=%d "
                 "seq=%lld\n", fd, h.cmd, (long long)h.seq);
@@ -578,6 +581,7 @@ bool Van::SendV(int fd, const MsgHeader& head, const struct iovec* segs,
       BPS_METRIC_COUNTER_ADD("bps_chaos_injected_total", 1);
       BPS_METRIC_COUNTER_ADD("bps_chaos_dup_total", 1);
       Trace::Get().Note("CHAOS_DUP", h.key, -1, h.req_id);
+      Events::Get().Emit(EV_CHAOS, /*kind=*/2, h.key);
       sends = 2;  // duplicate delivery, back-to-back, same seq
     }
     if (c.corrupt > 0 && payload_len > 0 &&
@@ -590,6 +594,7 @@ bool Van::SendV(int fd, const MsgHeader& head, const struct iovec* segs,
       BPS_METRIC_COUNTER_ADD("bps_chaos_injected_total", 1);
       BPS_METRIC_COUNTER_ADD("bps_chaos_corrupt_total", 1);
       Trace::Get().Note("CHAOS_CORRUPT", h.key, -1, h.req_id);
+      Events::Get().Emit(EV_CHAOS, /*kind=*/3, h.key);
       corrupt_scratch.resize(static_cast<size_t>(payload_len));
       size_t off = 0;
       for (int i = 0; i < nsegs; ++i) {

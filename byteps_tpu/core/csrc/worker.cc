@@ -8,6 +8,7 @@
 #include <map>
 
 #include "cpu_reducer.h"
+#include "events.h"
 #include "logging.h"
 #include "metrics.h"
 #include "roundstats.h"
@@ -419,6 +420,7 @@ void BytePSWorker::RecoverServer(int node_id) {
                    nullptr);
       Trace::Get().Note("RESEED_OFFER", a.p->key, node_id, -1,
                         a.p->reseed_round);
+      Events::Get().Emit(EV_RESEED, a.p->key, node_id, a.p->reseed_round);
       ++reseeded;
     }
   }
@@ -430,6 +432,8 @@ void BytePSWorker::RecoverServer(int node_id) {
   // The recovery's closing flight dump: the EPOCH_PAUSE dump predates
   // the re-seed, so refresh the file with the RESUME + reseed trail.
   Trace::Get().Note("RECOVER_DONE", repushed + reseeded, node_id);
+  Events::Get().Emit(EV_SERVER_RECOVER, node_id, repushed + reseeded,
+                     /*done=*/1);
   Trace::Get().FlightDumpAuto("recovery_complete");
 }
 

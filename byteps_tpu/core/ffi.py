@@ -158,6 +158,19 @@ def _load() -> ctypes.CDLL:
     lib.bps_ckpt_probe.restype = ctypes.c_longlong
     lib.bps_restore_round.argtypes = []
     lib.bps_restore_round.restype = ctypes.c_longlong
+    # Fleet event journal (ISSUE 20): the whole-journal JSON probe plus
+    # the emit / wire-fill / wire-ingest test hooks that drive the
+    # exact heartbeat piggyback path a live fleet uses.
+    lib.bps_events_summary.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+    lib.bps_events_summary.restype = ctypes.c_longlong
+    lib.bps_events_emit.argtypes = [ctypes.c_int, ctypes.c_longlong,
+                                    ctypes.c_longlong, ctypes.c_longlong]
+    lib.bps_events_emit.restype = ctypes.c_int
+    lib.bps_events_fill_wire.argtypes = [ctypes.c_char_p,
+                                         ctypes.c_longlong]
+    lib.bps_events_fill_wire.restype = ctypes.c_longlong
+    lib.bps_events_ingest.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+    lib.bps_events_ingest.restype = ctypes.c_int
     _lib = lib
     return lib
 
@@ -215,6 +228,69 @@ def round_ingest(payload: bytes) -> bool:
     """Ingest serialized heartbeat round-summary wire bytes; False when
     the payload is not a recognized summary (version interop)."""
     return bool(_load().bps_round_ingest(payload, len(payload)))
+
+
+# Fleet lifecycle event types (mirror csrc/events.h EventType — the
+# journal's versioned catalog; docs/monitoring.md "Event catalog").
+EVENT_TYPES = {
+    "epoch_pause": 1, "epoch_resume": 2, "fleet_pause": 3,
+    "fleet_resume": 4, "join": 5, "leave": 6, "death": 7,
+    "server_recover": 8, "reseed": 9, "sched_park": 10,
+    "sched_reregister": 11, "sched_recovery_commit": 12,
+    "ckpt_spill": 13, "ckpt_seal": 14, "ckpt_restore": 15,
+    "snap_commit": 16, "snap_evict": 17, "replica_lag": 18,
+    "crc_quarantine": 19, "crc_failstop": 20, "tenant_starved": 21,
+    "chaos": 22, "insight": 23, "shutdown": 24,
+}
+
+
+def events_summary() -> dict:
+    """Parse the fleet event journal snapshot (ISSUE 20): this rank's
+    local ring plus, on the scheduler, the clock-aligned fleet timeline
+    and the per-gauge metric history rings. Works in any process state
+    (pre-init ranks report an empty ring under node_id -1)."""
+    import json
+
+    lib = _load()
+    size = 1 << 16
+    while True:
+        buf = ctypes.create_string_buffer(size)
+        need = int(lib.bps_events_summary(buf, size))
+        if need < size:
+            return json.loads(buf.value.decode())
+        size = need + 1
+
+
+def events_emit(event: "str | int", a0: int = 0, a1: int = 0,
+                a2: int = 0) -> None:
+    """Journal one lifecycle event through the production Emit path —
+    the hook behind insight's classification journaling, the monitor
+    endpoint's POST /events, and the catalog-reachability tests."""
+    code = EVENT_TYPES[event] if isinstance(event, str) else int(event)
+    if _load().bps_events_emit(code, int(a0), int(a1), int(a2)) != 0:
+        raise ValueError(f"unknown event type {event!r}")
+
+
+def events_fill_wire() -> bytes:
+    """Drain the new-since-last-beat events into one heartbeat wire
+    chunk, exactly as HeartbeatLoop would. b"" when there is nothing
+    new or the journal is off (the heartbeat then carries no events
+    sub-payload at all — the PR 19 wire)."""
+    lib = _load()
+    size = 1 << 16
+    while True:
+        buf = ctypes.create_string_buffer(size)
+        n = int(lib.bps_events_fill_wire(buf, size))
+        if n >= 0:
+            return buf.raw[:n]
+        size = -n
+
+
+def events_ingest(payload: bytes) -> bool:
+    """Ingest one events wire chunk as the scheduler's heartbeat
+    handler would; False when the payload is not a recognized events
+    chunk (foreign magic, version skew, short frame)."""
+    return bool(_load().bps_events_ingest(payload, len(payload)))
 
 
 def elastic_probe(script: str) -> dict:

@@ -70,6 +70,17 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 body = json.dumps(doc).encode()
                 ctype = "application/json"
                 code = 200
+            elif self.path.split("?")[0] == "/events":
+                # Fleet event journal (ISSUE 20): this rank's local
+                # lifecycle-event ring; on the scheduler, also the
+                # clock-aligned fleet timeline and per-gauge history
+                # rings. `python -m byteps_tpu.monitor.incident` reads
+                # this to render a post-mortem; monitor.top's ticker
+                # tails it.
+                from byteps_tpu.core.ffi import events_summary
+                body = json.dumps(events_summary()).encode()
+                ctype = "application/json"
+                code = 200
             elif self.path.split("?")[0] == "/healthz":
                 snap = _metrics.snapshot()
                 dead = snap.get("dead_nodes", [])
@@ -199,6 +210,29 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def do_POST(self):  # noqa: N802 (http.server API)
+        # POST /events: journal one event from outside the C hot paths
+        # (insight posts its classification flips here so regressions
+        # land on the same incident timeline as the lifecycle events
+        # they explain). Body: {"type": name-or-code, "a0","a1","a2"}.
+        try:
+            if self.path.split("?")[0] != "/events":
+                body, code = b"not found\n", 404
+            else:
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                doc = json.loads(self.rfile.read(n).decode() or "{}")
+                from byteps_tpu.core.ffi import events_emit
+                events_emit(doc["type"], int(doc.get("a0", 0)),
+                            int(doc.get("a1", 0)), int(doc.get("a2", 0)))
+                body, code = b"ok\n", 200
+        except Exception as e:  # a bad post must not kill the job
+            body, code = f"event rejected: {e}\n".encode(), 400
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def log_message(self, *args):  # silence per-request stderr noise
         pass
 
@@ -237,7 +271,8 @@ def maybe_start_monitor(node_id: int) -> Optional[MonitorServer]:
             return None
         srv = MonitorServer(cfg.monitor_port + node_id)
         logging.getLogger("byteps_tpu.monitor").info(
-            "monitor endpoint on :%d (/metrics, /healthz)", srv.port)
+            "monitor endpoint on :%d (/metrics, /healthz, /events)",
+            srv.port)
         return srv
     except Exception as e:
         logging.getLogger("byteps_tpu.monitor").warning(

@@ -375,6 +375,39 @@ def _fmt_us(us: float) -> str:
     return f"{us / 1e3:.2f}ms" if us >= 1000 else f"{us:.0f}us"
 
 
+# Classification -> journal code (EV_INSIGHT a0; the catalog lives in
+# csrc/events.h and docs/monitoring.md "Event catalog"). Stable wire
+# values: append, never renumber.
+STATE_CODES = {
+    "healthy": 0, "wire-bound": 1, "sum-bound": 2,
+    "straggler-skewed": 3, "retry-degraded": 4,
+    "corruption-degraded": 5, "resizing": 6, "idle": 7,
+}
+
+
+def journal_state(endpoint: str, state: str, prev_state: str,
+                  timeout: float = 2.0) -> bool:
+    """Journal a classification FLIP onto the fleet event timeline
+    (POST /events, type=insight, a0=new code, a1=old code) so a
+    performance regression lands next to the lifecycle events that
+    explain it in `monitor.incident`. Edge-triggered by the caller —
+    posting every poll would bury the timeline. Best-effort: False
+    (and no raise) when the endpoint is unreachable."""
+    body = json.dumps({
+        "type": "insight",
+        "a0": STATE_CODES.get(state, -1),
+        "a1": STATE_CODES.get(prev_state, -1),
+    }).encode()
+    req = urllib.request.Request(
+        f"http://{endpoint}/events", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status == 200
+    except (OSError, ValueError):
+        return False
+
+
 def scrape_rounds(endpoint: str, timeout: float = 2.0) -> Optional[dict]:
     """Fetch one /rounds snapshot; None when unreachable."""
     try:
@@ -453,6 +486,7 @@ def main(argv=None) -> int:
         os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
         os.environ.get("BYTEPS_MONITOR_PORT", "9100"))
     last_printed = -1
+    last_state = None
     while True:
         summary = scrape_rounds(endpoint)
         if summary is None:
@@ -464,6 +498,12 @@ def main(argv=None) -> int:
             continue
         rep = analyze(summary, straggler_factor=args.straggler_factor,
                       window=args.window)
+        # Journal flips only (ISSUE 20): the first poll seeds the edge
+        # detector without posting, so attaching insight to a long-
+        # degraded fleet doesn't misreport the attach as a transition.
+        if last_state is not None and rep["state"] != last_state:
+            journal_state(endpoint, rep["state"], last_state)
+        last_state = rep["state"]
         if args.json:
             rep2 = dict(rep)
             print(json.dumps(rep2))

@@ -87,8 +87,35 @@ def _incarnation(meta: dict) -> int:
     return int(m.group(1)) if m else 0
 
 
+# Synthetic process row for the journal overlay: far above any real
+# node id (which stay < 100000 * incarnations in practice).
+_EVENTS_PID = 10 ** 9
+
+
+def journal_instants(journal: dict) -> List[dict]:
+    """Fleet event journal (ISSUE 20) -> Perfetto instant events, one
+    per timeline entry, already on the scheduler timebase (the journal
+    aligns at ingest). They ride a dedicated "fleet events" process
+    row so pauses, deaths, and recovery commits sit visually above the
+    per-rank spans they explain."""
+    out = []
+    for e in journal.get("timeline") or journal.get("events") or []:
+        out.append({
+            "name": e.get("name", "event"),
+            "ph": "i", "s": "g",  # global scope: full-height marker
+            "pid": _EVENTS_PID, "tid": e.get("node", -1),
+            "ts": e.get("ts_us", 0),
+            "args": {"node": e.get("node", -1),
+                     "role": e.get("role", -1),
+                     "a0": e.get("a0", 0), "a1": e.get("a1", 0),
+                     "a2": e.get("a2", 0)},
+        })
+    return out
+
+
 def merge_dumps(dumps: List[dict],
-                out_path: Optional[str] = None) -> dict:
+                out_path: Optional[str] = None,
+                journal: Optional[dict] = None) -> dict:
     """Merge per-rank dumps into one fleet trace.
 
     Clock alignment: each rank's events are shifted by its
@@ -139,13 +166,20 @@ def merge_dumps(dumps: List[dict],
             e2["pid"] = pid
             e2["ts"] = e["ts"] + offset
             events.append(e2)
+    overlay = journal_instants(journal) if journal else []
+    events += overlay
     events.sort(key=lambda e: e["ts"])
     merged_events: List[dict] = [
         {"name": "process_name", "ph": "M", "pid": r["pid"],
          "args": {"name": r["label"]}} for r in ranks]
+    if overlay:
+        merged_events.append({"name": "process_name", "ph": "M",
+                              "pid": _EVENTS_PID,
+                              "args": {"name": "fleet events"}})
     merged_events += events
     merged = {"traceEvents": merged_events,
-              "meta": {"ranks": ranks, "events": len(events)}}
+              "meta": {"ranks": ranks, "events": len(events),
+                       "journal_events": len(overlay)}}
     if out_path:
         with open(out_path, "w") as f:
             json.dump(merged, f)
@@ -354,6 +388,11 @@ def main(argv=None) -> int:
     p.add_argument("--out", default="",
                    help="merged trace output path (merge mode; default "
                         "<dir>/fleet.json)")
+    p.add_argument("--events", default="", metavar="JOURNAL",
+                   help="overlay the fleet event journal (a saved "
+                        "/events JSON, e.g. from monitor.incident) as "
+                        "Perfetto instant markers on a 'fleet events' "
+                        "row (merge mode)")
     p.add_argument("--straggler-factor", type=float,
                    default=float(os.environ.get("BYTEPS_STRAGGLER_FACTOR",
                                                 "2.0")))
@@ -370,7 +409,11 @@ def main(argv=None) -> int:
     flow_stats = None
     if args.cmd == "merge":
         out = args.out or os.path.join(args.dir, "fleet.json")
-        merged = merge_dumps(dumps, out_path=out)
+        journal = None
+        if args.events:
+            with open(args.events) as f:
+                journal = json.load(f)
+        merged = merge_dumps(dumps, out_path=out, journal=journal)
         flow_stats = check_flows(merged)
         print(f"merged {len(dumps)} rank dump(s), "
               f"{merged['meta']['events']} events -> {out}",

@@ -65,6 +65,23 @@ def scrape(endpoint: str, timeout: float = DEFAULT_TIMEOUT_S
         return None
 
 
+def scrape_events(endpoint: str, since_us: int = 0, limit: int = 10,
+                  timeout: float = DEFAULT_TIMEOUT_S) -> List[dict]:
+    """Tail the fleet event journal (ISSUE 20) from one endpoint's
+    /events — newest `limit` timeline entries newer than `since_us`
+    (aligned us), so repeated polls render a scrolling ticker instead
+    of reprinting history. [] when unreachable or journal off."""
+    try:
+        with urllib.request.urlopen(f"http://{endpoint}/events",
+                                    timeout=timeout) as r:
+            doc = json.loads(r.read().decode())
+    except (OSError, ValueError):
+        return []
+    evs = doc.get("timeline") or doc.get("events") or []
+    fresh = [e for e in evs if e.get("ts_us", 0) > since_us]
+    return fresh[-limit:]
+
+
 def _sample(metrics: dict, name: str, default: float = 0.0) -> float:
     series = metrics.get(name)
     if not series:
@@ -523,6 +540,17 @@ def _print_report(report: dict, as_json: bool) -> None:
                  "lagging_ckpt"):
         if report.get(kind):
             print(f"{kind}: {report[kind]}")
+    # Journal ticker (ISSUE 20): fresh fleet lifecycle events since the
+    # last poll, on the scheduler timebase. A pause that never resumes,
+    # a death, a quarantine — they land here the poll after they
+    # happen, without waiting for a gauge to move.
+    roles = {0: "sched", 1: "server", 2: "worker"}
+    for e in report.get("events") or []:
+        who = (f"{roles.get(e.get('role', -1), '?')}"
+               f"/n{e.get('node', -1)}")
+        args = ",".join(str(e.get(k, 0)) for k in ("a0", "a1", "a2"))
+        print(f"event: {e.get('ts_us', 0) / 1e6:>12.3f}s "
+              f"{e.get('name', '?'):<22} {who:<12} args=[{args}]")
 
 
 def main(argv=None) -> int:
@@ -564,10 +592,16 @@ def main(argv=None) -> int:
     else:
         eps = fleet_endpoints(args.host, args.base_port, args.num_workers,
                               args.num_servers, args.num_replicas)
+    last_ev_us = 0
     while True:
         report = analyze({name: scrape(ep) for name, ep in eps.items()},
                          straggler_factor=args.straggler_factor,
                          heartbeat_timeout_s=args.heartbeat_timeout)
+        if "scheduler" in eps:
+            fresh = scrape_events(eps["scheduler"], since_us=last_ev_us)
+            if fresh:
+                last_ev_us = max(e.get("ts_us", 0) for e in fresh)
+            report["events"] = fresh
         _print_report(report, args.json)
         if not args.watch:
             return 1 if (report["stragglers"] or report["dead_nodes"]

@@ -1,0 +1,487 @@
+"""Fleet event journal tests (ISSUE 20).
+
+Two tiers in one file:
+
+- FAST (tier-1, ``-m events``): the C-core journal driven through the
+  real FFI paths — catalog reachability + pinned names, ring
+  wraparound drop-oldest accounting, heartbeat wire chunk
+  interop (bad magic / version skew / short frames rejected), skewed-
+  clock ingest ordering on the scheduler timeline, the events-off wire
+  contract, the incident-report classifier, and the timeline journal
+  overlay. The journal is a leaked process-wide singleton, so
+  in-process assertions are DELTA-based (other tests share the ring);
+  env-sensitive cases (ring size, off switch) run in subprocesses.
+- PS tier (``pytest -m events -m ps``): the acceptance run — SIGKILL
+  the scheduler mid-training and assert the incident report scraped
+  from the crash-restarted scheduler names the fail-over chain
+  park -> re-register -> recovery-commit in clock-aligned order.
+"""
+
+import io
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from byteps_tpu.monitor import incident
+from byteps_tpu.monitor import timeline as tl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Wire layout mirrors csrc/events.h (packed, little-endian).
+_EHDR = struct.Struct("<HHiiiqqq")   # magic, ver, node, role, count,
+                                     # emitted_total, dropped, offset_us
+_EREC = struct.Struct("<iiiiqqqq")   # type, node, role, pad, ts, a0-a2
+_MAGIC = 0xE7B5
+_VERSION = 1
+assert _EHDR.size == 40 and _EREC.size == 48
+
+
+def _pack_chunk(node_id, recs, role=2, magic=_MAGIC, version=_VERSION,
+                count=None, emitted=None, dropped=0, offset_us=0):
+    hdr = _EHDR.pack(magic, version, node_id, role,
+                     count if count is not None else len(recs),
+                     emitted if emitted is not None else len(recs),
+                     dropped, offset_us)
+    return hdr + b"".join(recs)
+
+
+def _pack_rec(etype, node_id, role, ts_us, a0=0, a1=0, a2=0):
+    return _EREC.pack(etype, node_id, role, 0, ts_us, a0, a1, a2)
+
+
+def _drain_wire(ffi):
+    """Flush events other tests left pending so the next FillWire holds
+    only what THIS test emits."""
+    while ffi.events_fill_wire():
+        pass
+
+
+# --- fast tier: catalog + ring ---------------------------------------------
+
+@pytest.mark.events
+def test_catalog_every_type_reachable_and_names_pinned():
+    """Every cataloged type journals through the production Emit path
+    and renders its pinned wire name (codes are a wire contract:
+    append-only, never renumbered)."""
+    from byteps_tpu.core import ffi
+
+    assert ffi.EVENT_TYPES == {
+        "epoch_pause": 1, "epoch_resume": 2, "fleet_pause": 3,
+        "fleet_resume": 4, "join": 5, "leave": 6, "death": 7,
+        "server_recover": 8, "reseed": 9, "sched_park": 10,
+        "sched_reregister": 11, "sched_recovery_commit": 12,
+        "ckpt_spill": 13, "ckpt_seal": 14, "ckpt_restore": 15,
+        "snap_commit": 16, "snap_evict": 17, "replica_lag": 18,
+        "crc_quarantine": 19, "crc_failstop": 20, "tenant_starved": 21,
+        "chaos": 22, "insight": 23, "shutdown": 24,
+    }
+    marker = 0x20E0_0001
+    base = ffi.events_summary()["emitted_total"]
+    for name in ffi.EVENT_TYPES:
+        ffi.events_emit(name, marker, 7, 9)
+    s = ffi.events_summary()
+    assert s["emitted_total"] == base + len(ffi.EVENT_TYPES)
+    ours = [e for e in s["events"] if e["a0"] == marker]
+    assert [e["name"] for e in ours][-len(ffi.EVENT_TYPES):] == \
+        list(ffi.EVENT_TYPES)
+    for e in ours:
+        assert ffi.EVENT_TYPES[e["name"]] == e["type"]
+        assert (e["a1"], e["a2"]) == (7, 9)
+
+
+@pytest.mark.events
+def test_emit_rejects_types_outside_catalog():
+    from byteps_tpu.core import ffi
+
+    with pytest.raises(ValueError):
+        ffi.events_emit(99)
+    with pytest.raises(ValueError):
+        ffi.events_emit(0)  # EV_NONE is a sentinel, not a journal entry
+    with pytest.raises(KeyError):
+        ffi.events_emit("frobnicate")
+
+
+_SUBPROC_RING = """
+import json
+from byteps_tpu.core import ffi
+for i in range(40):
+    ffi.events_emit("chaos", i)
+s = ffi.events_summary()
+print(json.dumps({"on": s["on"], "emitted": s["emitted_total"],
+                  "dropped": s["dropped"],
+                  "a0s": [e["a0"] for e in s["events"]],
+                  "wire_len": len(ffi.events_fill_wire())}))
+"""
+
+
+def _run_sub(script, extra_env):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env)
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.events
+def test_ring_wraparound_drops_oldest():
+    """40 emits into a 16-slot ring (env floor): the newest 16 survive
+    in order, the 24 overwritten are counted as dropped, and the wire
+    chunk ships only what is still IN the ring."""
+    r = _run_sub(_SUBPROC_RING, {"BYTEPS_EVENTS_RING": "16"})
+    assert r["emitted"] == 40
+    assert r["dropped"] == 24
+    assert r["a0s"] == list(range(24, 40))
+    assert r["wire_len"] == _EHDR.size + 16 * _EREC.size
+
+
+@pytest.mark.events
+def test_ring_floor_clamps_tiny_env():
+    # BYTEPS_EVENTS_RING=1 clamps to the 16 floor, not a 1-slot ring.
+    r = _run_sub(_SUBPROC_RING, {"BYTEPS_EVENTS_RING": "1"})
+    assert r["a0s"] == list(range(24, 40))
+
+
+_SUBPROC_OFF = """
+import json
+from byteps_tpu.core import ffi
+ffi.events_emit("death", 3)
+s = ffi.events_summary()
+print(json.dumps({"on": s["on"], "emitted": s["emitted_total"],
+                  "events": s["events"],
+                  "wire": ffi.events_fill_wire().hex()}))
+"""
+
+
+@pytest.mark.events
+def test_events_off_emits_nothing_and_ships_nothing():
+    """BYTEPS_EVENTS_ON=0: every emit site is a no-op and FillWire
+    contributes zero bytes — the heartbeat wire is byte-identical to a
+    journal-less build."""
+    r = _run_sub(_SUBPROC_OFF, {"BYTEPS_EVENTS_ON": "0"})
+    assert r["on"] is False
+    assert r["emitted"] == 0
+    assert r["events"] == []
+    assert r["wire"] == ""
+
+
+# --- fast tier: heartbeat wire interop --------------------------------------
+
+@pytest.mark.events
+def test_fill_wire_roundtrips_through_ingest():
+    from byteps_tpu.core import ffi
+
+    _drain_wire(ffi)
+    marker = 0x20E0_0002
+    ffi.events_emit("ckpt_spill", marker, 4096)
+    ffi.events_emit("ckpt_seal", marker, 12, 1)
+    chunk = ffi.events_fill_wire()
+    magic, ver, _node, _role, count, _tot, _drop, _off = \
+        _EHDR.unpack_from(chunk, 0)
+    assert (magic, ver, count) == (_MAGIC, _VERSION, 2)
+    assert len(chunk) == _EHDR.size + 2 * _EREC.size
+    # Drained means drained: a second beat with nothing new ships
+    # nothing (the sub-payload disappears, it never repeats events).
+    assert ffi.events_fill_wire() == b""
+
+    before = ffi.events_summary()["ingested_total"]
+    assert ffi.events_ingest(chunk)
+    s = ffi.events_summary()
+    assert s["ingested_total"] == before + 2
+    ours = [e for e in s["timeline"] if e["a0"] == marker]
+    assert [e["name"] for e in ours[-2:]] == ["ckpt_spill", "ckpt_seal"]
+
+
+@pytest.mark.events
+def test_ingest_rejects_foreign_and_short_chunks():
+    from byteps_tpu.core import ffi
+
+    rec = _pack_rec(7, 9, 2, 1_000_000)
+    good = _pack_chunk(9, [rec])
+    assert ffi.events_ingest(good)
+    assert not ffi.events_ingest(_pack_chunk(9, [rec], magic=0xB57A))
+    assert not ffi.events_ingest(_pack_chunk(9, [rec], version=2))
+    assert not ffi.events_ingest(good[:_EHDR.size + 20])  # short frame
+    assert not ffi.events_ingest(good[:12])               # short header
+    assert not ffi.events_ingest(_pack_chunk(9, [rec], count=65))
+    assert not ffi.events_ingest(_pack_chunk(9, [rec], count=-1))
+    assert not ffi.events_ingest(b"")
+
+
+@pytest.mark.events
+def test_ingest_header_identity_backfills_pretopology_records():
+    """A record emitted before SetNode carries -1/-1; the scheduler
+    trusts the chunk header's identity instead of dropping it."""
+    from byteps_tpu.core import ffi
+
+    marker = 0x20E0_0003
+    rec = _pack_rec(10, -1, -1, 2_000_000, marker)
+    assert ffi.events_ingest(_pack_chunk(6, [rec], role=2))
+    e = [t for t in ffi.events_summary()["timeline"]
+         if t["a0"] == marker][-1]
+    assert (e["node"], e["role"]) == (6, 2)
+
+
+@pytest.mark.events
+def test_skewed_clock_ingest_orders_by_aligned_time():
+    """Node 7's clock runs 1s behind (offset +1s): its locally-earlier
+    timestamp lands AFTER node 8's on the fleet timeline. The timeline
+    sorts by aligned time, not arrival or local time."""
+    from byteps_tpu.core import ffi
+
+    marker = 0x20E0_0004
+    early_local = _pack_rec(10, 7, 2, 5_000_000, marker)   # aligned 6.0s
+    later_local = _pack_rec(11, 8, 2, 5_500_000, marker)   # aligned 5.5s
+    assert ffi.events_ingest(_pack_chunk(7, [early_local],
+                                         offset_us=1_000_000))
+    assert ffi.events_ingest(_pack_chunk(8, [later_local]))
+    ours = [e for e in ffi.events_summary()["timeline"]
+            if e["a0"] == marker]
+    assert [(e["node"], e["ts_us"]) for e in ours] == \
+        [(8, 5_500_000), (7, 6_000_000)]
+
+
+# --- fast tier: config, incident reports, overlays --------------------------
+
+@pytest.mark.events
+def test_config_events_validation():
+    from byteps_tpu.config import Config
+
+    Config().validate()
+    with pytest.raises(ValueError, match="BYTEPS_EVENTS_RING"):
+        Config(events_ring=8).validate()
+    with pytest.raises(ValueError, match="BYTEPS_EVENTS_HISTORY"):
+        Config(events_history=4).validate()
+
+
+def _synthetic_journal():
+    mk = lambda t, name, node, role, **a: {
+        "type": 0, "name": name, "node": node, "role": role,
+        "ts_us": t, "a0": a.get("a0", 0), "a1": a.get("a1", 0),
+        "a2": a.get("a2", 0)}
+    return {
+        "on": True, "role": 0, "node_id": 0, "ring_capacity": 512,
+        "emitted_total": 4, "dropped": 0, "clock_offset_us": 0,
+        "events": [], "timeline_dropped": 0, "ingested_total": 4,
+        "timeline": [
+            mk(1_000_000, "join", 3, 2),
+            mk(5_000_000, "sched_park", 3, 2, a0=30000),
+            mk(7_000_000, "sched_reregister", 3, 0),
+            mk(9_000_000, "sched_recovery_commit", 0, 0, a0=1, a1=4),
+        ],
+        "history": {"bps_membership_epoch":
+                    [[1_000_000, 0], [9_000_000, 1]]},
+    }
+
+
+@pytest.mark.events
+def test_incident_report_classifies_and_windows():
+    j = _synthetic_journal()
+    r = incident.build_report(j)
+    assert r["source"]["scheduler"] is True
+    assert "sched_park" in r["severe"]
+    assert "sched_recovery_commit" in r["resolved"]
+    assert [e["name"] for e in r["events"]] == [
+        "join", "sched_park", "sched_reregister",
+        "sched_recovery_commit"]
+    assert r["history"]["bps_membership_epoch"]["last"] == 1
+    # Windowing: the last 1.5 seconds keep only the commit, and a severe
+    # event outside the window no longer colors the verdict.
+    r = incident.build_report(j, window_s=1.5)
+    assert [e["name"] for e in r["events"]] == ["sched_recovery_commit"]
+    assert r["severe"] == []
+
+    buf = io.StringIO()
+    incident.render_report(incident.build_report(j), file=buf)
+    text = buf.getvalue()
+    assert "severe: sched_park" in text
+    assert "resolved by: join, sched_recovery_commit" in text
+    assert "sched_reregister" in text
+
+
+@pytest.mark.events
+def test_incident_report_flags_unresolved_and_drops():
+    j = _synthetic_journal()
+    j["timeline"] = [e for e in j["timeline"]
+                     if e["name"] in ("sched_park",)]
+    j["dropped"] = 5
+    buf = io.StringIO()
+    incident.render_report(incident.build_report(j), file=buf)
+    text = buf.getvalue()
+    assert "NOT resolved in window" in text
+    assert "5 event(s) dropped" in text
+
+
+@pytest.mark.events
+def test_incident_falls_back_to_local_ring_off_scheduler():
+    j = {"on": True, "role": 2, "node_id": 4, "emitted_total": 1,
+         "dropped": 0, "timeline": [], "ingested_total": 0,
+         "timeline_dropped": 0, "history": {},
+         "events": [{"type": 7, "name": "death", "node": 4, "role": 2,
+                     "ts_us": 1_000_000, "a0": 2, "a1": 0, "a2": 0}]}
+    r = incident.build_report(j)
+    assert r["source"]["scheduler"] is False
+    assert [e["name"] for e in r["events"]] == ["death"]
+    assert r["severe"] == ["death"]
+
+
+@pytest.mark.events
+def test_timeline_merge_overlays_journal_instants(tmp_path):
+    j = _synthetic_journal()
+    merged = tl.merge_dumps([], journal=j)
+    instants = [e for e in merged["traceEvents"] if e.get("ph") == "i"]
+    assert [e["name"] for e in instants] == [
+        "join", "sched_park", "sched_reregister",
+        "sched_recovery_commit"]
+    assert all(e["pid"] == tl._EVENTS_PID and e["s"] == "g"
+               for e in instants)
+    assert any(e.get("ph") == "M" and
+               e["args"]["name"] == "fleet events"
+               for e in merged["traceEvents"])
+    assert merged["meta"]["journal_events"] == 4
+    # The CLI path: --events <saved journal> on a dumpless dir.
+    jf = tmp_path / "events.json"
+    jf.write_text(json.dumps(j))
+    out = tmp_path / "fleet.json"
+    (tmp_path / "flight_r2_n3.json").write_text(json.dumps(
+        {"meta": {"role": 2, "node_id": 3, "clock_offset_us": 0},
+         "traceEvents": [{"name": "push", "ph": "X", "ts": 1_500_000,
+                          "dur": 10, "tid": 0}]}))
+    assert tl.main(["merge", "--dir", str(tmp_path), "--glob",
+                    "flight_*.json", "--events", str(jf),
+                    "--out", str(out)]) == 0
+    merged = json.loads(out.read_text())
+    assert merged["meta"]["journal_events"] == 4
+
+
+@pytest.mark.events
+def test_snapshot_client_stats_initial_shape():
+    from byteps_tpu.client import SnapshotClient
+
+    c = SnapshotClient(endpoints=["127.0.0.1:1"])
+    st = c.stats()
+    assert st["pulls"] == 0 and st["keys"] == 0
+    assert st["failovers"] == 0 and st["retries"] == 0
+    assert st["latency_us_mean"] == 0.0
+    assert st["latency_us_min"] == 0.0  # not inf before the first pull
+
+
+# --- ps tier: the fail-over acceptance --------------------------------------
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_ps_worker.py")
+
+
+def _scrape_events(port, timeout=5.0):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/events",
+                                timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+@pytest.mark.ps
+@pytest.mark.events
+@pytest.mark.schedrec
+def test_incident_report_names_failover_chain():
+    """SIGKILL the scheduler mid-training. The workers journal the park
+    locally while the scheduler is DOWN; the crash-restarted scheduler
+    journals each re-registration and the recovery commit; the park
+    events ship on the first heartbeat to the new incarnation. The
+    incident report scraped from the recovered scheduler must name
+    park -> re-register -> recovery-commit in clock-aligned order."""
+    from tests.ps_utils import free_port, spawn_role, spawn_worker, \
+        topology_env
+    from tests.test_insight_fleet import _free_port_block
+    from tests.test_recovery import _wait_for_round
+
+    mbase = _free_port_block(5)
+    port = free_port()
+    env = topology_env(2, 2, port, {
+        "PS_HEARTBEAT_INTERVAL": "0.5",
+        "PS_HEARTBEAT_TIMEOUT": "2",
+        "BYTEPS_SCHED_RECOVERY_TIMEOUT_MS": "30000",
+        "BYTEPS_RECOVERY_TIMEOUT_MS": "20000",
+        "BYTEPS_RETRY_TIMEOUT_MS": "300",
+        "BYTEPS_RECONNECT_BACKOFF_MS": "50",
+        "BYTEPS_MONITOR_ON": "1",
+        "BYTEPS_MONITOR_PORT": str(mbase),
+        "BPS_TEST_ROUNDS": "16",
+        "BPS_TEST_ROUND_SLEEP": "0.4",
+    })
+    sched = spawn_role("scheduler", env)
+    servers = [spawn_role("server", env) for _ in range(2)]
+    workers = [spawn_worker(WORKER, env, r, "recovery") for r in range(2)]
+    replacement = None
+    procs = [sched, *servers, *workers]
+    chain = ("sched_park", "sched_reregister", "sched_recovery_commit")
+    try:
+        _wait_for_round(workers[0], 1)
+        sched.kill()  # hard death: no goodbye, journal gone with it
+        time.sleep(1.0)
+        renv = dict(env)
+        renv["DMLC_SCHED_RECOVER"] = "1"
+        replacement = spawn_role("scheduler", renv)
+        procs.append(replacement)
+
+        # Poll the RECOVERED scheduler's /events until the whole chain
+        # has landed (the park arrives one heartbeat after the commit).
+        journal = None
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            try:
+                journal = _scrape_events(mbase)
+                names = {e["name"] for e in journal["timeline"]}
+                if all(n in names for n in chain):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"fail-over chain never fully journaled: "
+                        f"{journal and sorted({e['name'] for e in journal['timeline']})}")
+
+        # A couple more beats let the 1 Hz gauge sampler and the
+        # post-recovery lifecycle events (snapshot commits) land, so
+        # the report window spans real history samples.
+        time.sleep(2.5)
+        journal = _scrape_events(mbase)
+        report = incident.build_report(journal)
+        first = {}
+        for i, e in enumerate(report["events"]):
+            first.setdefault(e["name"], i)
+        assert all(n in first for n in chain)
+        assert first["sched_park"] < first["sched_reregister"] \
+            < first["sched_recovery_commit"], report["events"]
+        assert "sched_park" in report["severe"]
+        assert "sched_recovery_commit" in report["resolved"]
+        # Park events were emitted by the WORKERS while the scheduler
+        # was dead, and still made the fleet timeline.
+        parks = [e for e in report["events"]
+                 if e["name"] == "sched_park"]
+        assert all(e["role"] != 0 for e in parks), parks
+        # The history rings sampled gauges across the incident.
+        assert journal["history"], "no gauge history on the scheduler"
+
+        for wp in workers:
+            out, _ = wp.communicate(timeout=150)
+            assert wp.returncode == 0, out
+            rows = [json.loads(ln) for ln in out.splitlines()
+                    if ln.startswith("{")]
+            assert rows and rows[-1]["sched_recoveries"] == 1
+        for srv in servers:
+            srv_out, _ = srv.communicate(timeout=30)
+            assert srv.returncode == 0, srv_out
+        rout, _ = replacement.communicate(timeout=30)
+        assert replacement.returncode == 0, rout
+        sched.communicate()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()

@@ -57,6 +57,7 @@ KNOWN_FAMILIES = frozenset({
     "ckpt",         # ISSUE 18: durable-checkpoint spill overhead + restore curve
     "compression",
     "elastic",
+    "events",       # ISSUE 20: fleet event journal on/off overhead
     "gate",
     "gpt2",
     "insight",
